@@ -1,0 +1,24 @@
+"""dllama-tpu: a TPU-native tensor-parallel LLM inference framework.
+
+A ground-up re-design of the capabilities of b4rtaz/distributed-llama
+(reference at /root/reference) for TPU hardware:
+
+- the reference's hand-written C++ graph IR + pthread executor collapse into
+  JAX-jitted SPMD programs (one compiled step; XLA schedules and fuses),
+- its TCP-socket collectives (all-gather / gather-to-root) become XLA
+  collectives over ICI/DCN driven by `jax.sharding.NamedSharding`,
+- its NEON/AVX2 kernels (Q40xQ80 matmul, multi-head attention) become Pallas
+  TPU kernels riding the MXU,
+- the `.m` model format, `.t` tokenizer format, converter tooling, CLI
+  surface and OpenAI-compatible API server are kept capability-compatible.
+
+Package layout:
+    formats/   .m / .t file formats, Q40/Q80 block quantization
+    models/    model configs + pure-functional forward passes (Llama, Qwen3, Qwen3-MoE)
+    ops/       compute ops: jnp reference impls + Pallas TPU kernels
+    parallel/  device mesh, tensor-parallel sharding rules, collectives
+    runtime/   inference engine (KV cache, prefill/decode), sampler, API server
+    utils/     logging, timing
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,155 @@
+"""Shared test fixtures: synthetic tiny models written in the real `.m`/`.t`
+wire formats, so the whole read path (header parse -> tensor plan -> dequant)
+is exercised exactly as it is for real checkpoints."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dllama_tpu.formats import FloatType
+from dllama_tpu.formats.model_file import LlmArch
+from dllama_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer
+from dllama_tpu.formats.writer import write_header, write_tensor
+
+TINY = dict(
+    dim=64,
+    hidden_dim=160,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=16,
+    vocab_size=256,
+    seq_len=64,
+)
+
+TINY_MOE = dict(
+    dim=64,
+    hidden_dim=160,
+    moe_hidden_dim=96,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=16,
+    vocab_size=256,
+    seq_len=64,
+    n_experts=4,
+    n_active_experts=2,
+)
+
+
+def make_tiny_model(
+    path,
+    arch: LlmArch = LlmArch.LLAMA,
+    weight_type: FloatType = FloatType.Q40,
+    seed: int = 0,
+    cfg: dict | None = None,
+    rope_scaling: bool = False,
+) -> dict[str, np.ndarray]:
+    """Write a tiny random model to `path`; returns the exact f32 tensors
+    (pre-quantization) keyed by plan name."""
+    if cfg is None:
+        cfg = dict(TINY_MOE if arch == LlmArch.QWEN3_MOE else TINY)
+    rng = np.random.default_rng(seed)
+    d = cfg["dim"]
+    hd = cfg["head_dim"]
+    q_dim = hd * cfg["n_heads"]
+    kv_dim = hd * cfg["n_kv_heads"]
+    n_experts = cfg.get("n_experts", 0)
+    ff = cfg["moe_hidden_dim"] if arch == LlmArch.QWEN3_MOE else cfg["hidden_dim"]
+
+    params = {
+        "version": 0,
+        "arch_type": int(arch),
+        "dim": d,
+        "hidden_dim": cfg["hidden_dim"],
+        "n_layers": cfg["n_layers"],
+        "n_heads": cfg["n_heads"],
+        "n_kv_heads": cfg["n_kv_heads"],
+        "n_experts": n_experts,
+        "n_active_experts": cfg.get("n_active_experts", 0),
+        "vocab_size": cfg["vocab_size"],
+        "max_seq_len": cfg["seq_len"],
+        "hidden_act": 1,  # silu
+        "rope_theta": 10000,
+        "weights_float_type": int(weight_type),
+        "head_dim": hd,
+        "norm_epsilon": 5,
+    }
+    if arch == LlmArch.QWEN3_MOE:
+        params["moe_hidden_dim"] = cfg["moe_hidden_dim"]
+    if rope_scaling:
+        params.update(
+            rope_type=2,  # llama3.1
+            rope_scaling_factor=8,
+            rope_scaling_low_freq_factor=1,
+            rope_scaling_high_freq_factory=4,
+            rope_scaling_orig_max_seq_len=cfg["seq_len"] // 2,
+        )
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.08).astype(np.float32)
+
+    tensors: dict[str, tuple[np.ndarray, FloatType]] = {}
+
+    def add(name, arr, ft):
+        tensors[name] = (arr, ft)
+
+    wt = weight_type
+    add("embed", t(cfg["vocab_size"], d), FloatType.F32)
+    for l in range(cfg["n_layers"]):
+        add(f"layers.{l}.q", t(q_dim, d), wt)
+        add(f"layers.{l}.k", t(kv_dim, d), wt)
+        add(f"layers.{l}.v", t(kv_dim, d), wt)
+        add(f"layers.{l}.wo", t(d, q_dim), wt)
+        if n_experts > 0:
+            add(f"layers.{l}.moe_gate", t(n_experts, d), FloatType.F32)
+            for e in range(n_experts):
+                add(f"layers.{l}.experts.{e}.w1", t(ff, d), wt)
+                add(f"layers.{l}.experts.{e}.w2", t(d, ff), wt)
+                add(f"layers.{l}.experts.{e}.w3", t(ff, d), wt)
+        else:
+            add(f"layers.{l}.w1", t(ff, d), wt)
+            add(f"layers.{l}.w2", t(d, ff), wt)
+            add(f"layers.{l}.w3", t(ff, d), wt)
+        if arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE):
+            add(f"layers.{l}.q_norm", 1.0 + t(hd), FloatType.F32)
+            add(f"layers.{l}.k_norm", 1.0 + t(hd), FloatType.F32)
+        add(f"layers.{l}.att_norm", 1.0 + t(d), FloatType.F32)
+        add(f"layers.{l}.ffn_norm", 1.0 + t(d), FloatType.F32)
+    add("final_norm", 1.0 + t(d), FloatType.F32)
+    add("wcls", t(cfg["vocab_size"], d), wt)
+
+    with open(path, "wb") as f:
+        write_header(f, params)
+        for name, (arr, ft) in tensors.items():
+            write_tensor(f, arr, ft)
+
+    return {name: arr for name, (arr, ft) in tensors.items()}
+
+
+def make_tiny_tokenizer(path, chat_template: str | None = None) -> TokenizerData:
+    """A tiny byte-level tokenizer: 256 single-byte regular tokens, then a few
+    merged tokens, then specials. Regular/special split at bos_id, matching
+    the reference layout assumption (src/tokenizer.cpp:138-140)."""
+    vocab: list[bytes] = [bytes([i]) for i in range(256)]
+    scores: list[float] = [0.0] * 256
+    merges = [b"he", b"ll", b"llo", b"hello", b" wor", b" world", b"hi", b"th", b"the"]
+    for i, m in enumerate(merges):
+        vocab.append(m)
+        scores.append(float(i + 1))
+    bos_id = len(vocab)
+    specials = [b"<s>", b"</s>", b"<|eot|>"]
+    for s in specials:
+        vocab.append(s)
+        scores.append(0.0)
+    data = TokenizerData(
+        vocab=vocab,
+        scores=scores,
+        bos_id=bos_id,
+        add_bos=True,
+        eos_token_ids=[bos_id + 1, bos_id + 2],
+        chat_template=chat_template,
+        max_token_length=max(len(v) for v in vocab),
+    )
+    write_tokenizer(path, data)
+    return data

@@ -1,0 +1,105 @@
+"""`.m` / `.t` format round-trip tests (reference pattern: converter golden
+tests + loadLlmHeader parse, src/llm.cpp:36-116)."""
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import FloatType, ModelReader, read_llm_header, read_tokenizer
+from dllama_tpu.formats.model_file import LlmArch, RopeType
+
+from helpers import TINY, make_tiny_model, make_tiny_tokenizer
+
+
+def test_header_roundtrip(tmp_path):
+    path = tmp_path / "tiny.m"
+    make_tiny_model(path)
+    h = read_llm_header(str(path))
+    assert h.arch == LlmArch.LLAMA
+    assert h.dim == TINY["dim"]
+    assert h.hidden_dim == TINY["hidden_dim"]
+    assert h.n_layers == TINY["n_layers"]
+    assert h.n_heads == TINY["n_heads"]
+    assert h.n_kv_heads == TINY["n_kv_heads"]
+    assert h.head_dim == TINY["head_dim"]
+    assert h.q_dim == 64
+    assert h.kv_dim == 32
+    assert h.vocab_size == TINY["vocab_size"]
+    assert h.seq_len == TINY["seq_len"]
+    assert h.weight_type == FloatType.Q40
+    assert h.rope_type == RopeType.LLAMA
+    assert h.norm_epsilon == pytest.approx(1e-5)
+
+
+def test_header_max_seq_len_clamp(tmp_path):
+    path = tmp_path / "tiny.m"
+    make_tiny_model(path)
+    h = read_llm_header(str(path), max_seq_len=16)
+    assert h.seq_len == 16
+    assert h.orig_seq_len == TINY["seq_len"]
+
+
+def test_qwen3_forces_falcon_rope(tmp_path):
+    path = tmp_path / "tiny.m"
+    make_tiny_model(path, arch=LlmArch.QWEN3)
+    h = read_llm_header(str(path))
+    assert h.rope_type == RopeType.FALCON
+
+
+def test_tensor_roundtrip_f32(tmp_path):
+    path = tmp_path / "tiny.m"
+    tensors = make_tiny_model(path, weight_type=FloatType.F32)
+    r = ModelReader(str(path))
+    for name, expected in tensors.items():
+        np.testing.assert_array_equal(r.dense_f32(name), expected)
+
+
+def test_tensor_roundtrip_q40(tmp_path):
+    path = tmp_path / "tiny.m"
+    tensors = make_tiny_model(path, weight_type=FloatType.Q40)
+    r = ModelReader(str(path))
+    # F32 tensors exact; Q40 within block-scale tolerance.
+    np.testing.assert_array_equal(r.dense_f32("embed"), tensors["embed"])
+    w = r.dense_f32("layers.0.q")
+    exact = tensors["layers.0.q"]
+    assert w.shape == exact.shape
+    assert np.abs(w - exact).max() < np.abs(exact).max() / 4
+    # Planar view is consistent with the dense dequant.
+    q, d = r.planar_q40("layers.0.q")
+    manual = (
+        q.reshape(-1, 32).astype(np.float32) * d.reshape(-1).astype(np.float32)[:, None]
+    ).reshape(w.shape)
+    np.testing.assert_allclose(manual, w, rtol=0, atol=0)
+
+
+def test_moe_plan(tmp_path):
+    path = tmp_path / "tiny_moe.m"
+    tensors = make_tiny_model(path, arch=LlmArch.QWEN3_MOE)
+    r = ModelReader(str(path))
+    assert r.header.is_moe
+    assert r.header.ff_dim == r.header.moe_hidden_dim
+    assert "layers.0.experts.3.w2" in r.by_name
+    assert "layers.0.q_norm" in r.by_name
+    np.testing.assert_array_equal(
+        r.dense_f32("layers.1.moe_gate"), tensors["layers.1.moe_gate"]
+    )
+
+
+def test_file_size_validation(tmp_path):
+    path = tmp_path / "tiny.m"
+    make_tiny_model(path)
+    with open(path, "ab") as f:
+        f.write(b"\x00" * 8)
+    with pytest.raises(ValueError, match="size mismatch"):
+        ModelReader(str(path))
+
+
+def test_tokenizer_roundtrip(tmp_path):
+    path = tmp_path / "tok.t"
+    data = make_tiny_tokenizer(str(path), chat_template="<|im_start|>{{x}}")
+    rt = read_tokenizer(str(path))
+    assert rt.vocab == data.vocab
+    assert rt.scores == pytest.approx(data.scores)
+    assert rt.bos_id == data.bos_id
+    assert rt.add_bos is True
+    assert rt.eos_token_ids == data.eos_token_ids
+    assert rt.chat_template == "<|im_start|>{{x}}"

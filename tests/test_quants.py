@@ -1,0 +1,107 @@
+"""Q40/Q80 codec tests.
+
+Mirrors the reference's test strategy (SURVEY.md §4): golden bytes for the
+serialized form (converter/writer-test.py) and quantize->dequantize roundtrip
+tolerance (src/nn/nn-cpu-ops-test.cpp:87-104).
+"""
+
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import (
+    Q40_BLOCK_SIZE,
+    quantize_q40,
+    quantize_q80,
+    dequantize_q40,
+    dequantize_q80,
+    q40_to_planar,
+    q80_to_planar,
+    tensor_bytes,
+)
+from dllama_tpu.formats.quants import FloatType, quantize_q80_values
+
+# Golden hex of Q40(torch.manual_seed(1); torch.randn(32, 16)) — identical to
+# the reference's converter/writer-test.py EXPECTED_OUTPUT.
+GOLDEN_Q40_HEX = (
+    "7e346345a692b89665b2c5790537876e598aaa366d988876a898b8d788a98868ce660c66f6b3a8"
+    "8cba5ce9a871987ba9cc5bcaaa760c1eb556a4455b747b6b9504968828ef2a8d7c1db5c6be3764"
+    "799e66db6d8e76463126a30e4333cad7a4f645947c6cf97f9de086d468c8d535a6ba7dc799d3d0"
+    "c657bab6799468cad8bb349eb7d7635c7c798998696bb38e4085a9eb34444ba96a7f8ba7b2b42d"
+    "746a96cf9660aeb4499d8708ad5c7b9a7558947645f3bbb6b0346a656887ad9a86059baac5c596"
+    "ab781c703569bb8a4356a4bd58cb78736ba09759bb0e34a6274e827b957d7a67dfa86846955660"
+    "d234b6d9d78a378094a8a8708a7a774ae92f8a36b8c999a9b77a7d958a69747c807963941235379"
+    "886d69a7a8767b3a6a4ac71999760"
+)
+
+
+def test_q40_golden_bytes():
+    torch = pytest.importorskip("torch")
+    torch.manual_seed(1)
+    x = torch.randn(32, 16).numpy()
+    raw = quantize_q40(x)
+    assert raw.tobytes().hex() == GOLDEN_Q40_HEX
+
+
+def test_q40_roundtrip_tolerance():
+    rng = np.random.default_rng(12345)
+    x = rng.standard_normal(4096).astype(np.float32)
+    raw = quantize_q40(x)
+    y = dequantize_q40(raw, x.size)
+    # Reference tolerance model: 4-bit asymmetric, error bounded by the scale.
+    scales = np.abs(x.reshape(-1, Q40_BLOCK_SIZE)).max(axis=1) / 8.0
+    err = np.abs(x - y).reshape(-1, Q40_BLOCK_SIZE)
+    assert (err <= scales[:, None] * 1.01 + 1e-6).all()
+
+
+def test_q80_roundtrip_tight():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal(2048).astype(np.float32)
+    raw = quantize_q80(x)
+    y = dequantize_q80(raw, x.size)
+    scales = np.abs(x.reshape(-1, 32)).max(axis=1) / 127.0
+    err = np.abs(x - y).reshape(-1, 32)
+    # 0.5 ulp of the int8 round + fp16 rounding of the stored scale
+    # (quantization divides by the f32 scale, dequant multiplies by its
+    # fp16-rounded value — same asymmetry as the reference writer).
+    assert (err <= scales[:, None] * (0.5 + 127 * 2**-11) + 1e-7).all()
+
+
+def test_q40_planar_matches_dequant():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal(1024).astype(np.float32)
+    raw = quantize_q40(x)
+    q, d = q40_to_planar(raw, x.size)
+    assert q.dtype == np.int8 and d.dtype == np.float16
+    assert q.min() >= -8 and q.max() <= 7
+    manual = (q.reshape(-1, 32).astype(np.float32) * d.astype(np.float32)[:, None]).reshape(-1)
+    np.testing.assert_allclose(manual, dequantize_q40(raw, x.size), rtol=0, atol=0)
+
+
+def test_q80_planar_matches_dequant():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal(1024).astype(np.float32)
+    raw = quantize_q80(x)
+    q, d = q80_to_planar(raw, x.size)
+    manual = (q.reshape(-1, 32).astype(np.float32) * d.astype(np.float32)[:, None]).reshape(-1)
+    np.testing.assert_allclose(manual, dequantize_q80(raw, x.size), rtol=0, atol=0)
+
+
+def test_q80_values_roundtrip():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal(256).astype(np.float32)
+    q, d = quantize_q80_values(x)
+    y = (q.reshape(-1, 32).astype(np.float32) * d.astype(np.float32)[:, None]).reshape(-1)
+    assert np.abs(x - y).max() < np.abs(x).max() / 64
+
+
+def test_tensor_bytes():
+    assert tensor_bytes(FloatType.F32, 64) == 256
+    assert tensor_bytes(FloatType.F16, 64) == 128
+    assert tensor_bytes(FloatType.Q40, 64) == 2 * 18
+    assert tensor_bytes(FloatType.Q80, 64) == 2 * 34
+
+
+def test_q40_zero_block():
+    x = np.zeros(32, dtype=np.float32)
+    raw = quantize_q40(x)
+    np.testing.assert_array_equal(dequantize_q40(raw, 32), x)
