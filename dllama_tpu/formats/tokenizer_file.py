@@ -65,7 +65,7 @@ def read_tokenizer(path: str) -> TokenizerData:
     with open(path, "rb") as f:
         (magic,) = struct.unpack("<i", f.read(4))
         if magic == TOKENIZER_OLD_MAGIC:
-            raise ValueError("old tokenizer format (0x567123) is not supported")
+            return _read_old_tokenizer(f)
         if magic != TOKENIZER_MAGIC:
             raise ValueError(f"invalid tokenizer magic: {magic:#x}")
 
@@ -134,6 +134,35 @@ def read_tokenizer(path: str) -> TokenizerData:
         add_bos=add_bos,
         eos_token_ids=eos_token_ids,
         chat_template=chat_template,
+        max_token_length=max_token_length,
+    )
+
+
+def _read_old_tokenizer(f) -> TokenizerData:
+    """Read the legacy fixed-header format (magic 0x567123): the 5-field
+    TokenizerOldHeader then the vocab section (reference:
+    src/tokenizer.hpp:13-19, src/tokenizer.cpp:57-64)."""
+    vocab_size, max_token_length, bos_id, eos_id, _pad_id = struct.unpack(
+        "<IIiii", f.read(20)
+    )
+    if max_token_length < 1:
+        raise ValueError("invalid tokenizer max token length")
+    vocab: list[bytes] = []
+    scores: list[float] = []
+    for _ in range(vocab_size):
+        score, length = struct.unpack("<fi", f.read(8))
+        vocab.append(f.read(length))
+        scores.append(score)
+    return TokenizerData(
+        vocab=vocab,
+        scores=scores,
+        bos_id=bos_id,
+        # The old header carries no add_bos flag (the reference leaves the
+        # field unset on this path); legacy sentencepiece tokenizers prepend
+        # BOS, so default True.
+        add_bos=True,
+        eos_token_ids=[eos_id],
+        chat_template=None,
         max_token_length=max_token_length,
     )
 

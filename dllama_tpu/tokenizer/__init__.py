@@ -1,0 +1,17 @@
+from .bpe import Tokenizer
+from .chat import (
+    ChatItem,
+    ChatTemplateType,
+    ChatTemplateGenerator,
+    EosDetector,
+    EosResult,
+)
+
+__all__ = [
+    "Tokenizer",
+    "ChatItem",
+    "ChatTemplateType",
+    "ChatTemplateGenerator",
+    "EosDetector",
+    "EosResult",
+]

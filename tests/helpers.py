@@ -133,7 +133,11 @@ def make_tiny_tokenizer(path, chat_template: str | None = None) -> TokenizerData
     the reference layout assumption (src/tokenizer.cpp:138-140)."""
     vocab: list[bytes] = [bytes([i]) for i in range(256)]
     scores: list[float] = [0.0] * 256
-    merges = [b"he", b"ll", b"llo", b"hello", b" wor", b" world", b"hi", b"th", b"the"]
+    merges = [
+        b"he", b"ll", b"llo", b"hello",
+        b" w", b" wo", b" wor", b" worl", b" world",
+        b"hi", b"th", b"the",
+    ]
     for i, m in enumerate(merges):
         vocab.append(m)
         scores.append(float(i + 1))

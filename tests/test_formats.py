@@ -103,3 +103,23 @@ def test_tokenizer_roundtrip(tmp_path):
     assert rt.add_bos is True
     assert rt.eos_token_ids == data.eos_token_ids
     assert rt.chat_template == "<|im_start|>{{x}}"
+
+
+def test_old_tokenizer_format(tmp_path):
+    # Legacy magic 0x567123 with the fixed 5-field header
+    # (reference: src/tokenizer.cpp:57-64).
+    import struct
+
+    path = tmp_path / "old.t"
+    vocab = [b"a", b"bc", b"<s>"]
+    scores = [0.0, 1.5, 0.0]
+    with open(path, "wb") as f:
+        f.write(struct.pack("<iIIiii", 0x567123, len(vocab), 2, 2, 1, -1))
+        for v, s in zip(vocab, scores):
+            f.write(struct.pack("<fi", s, len(v)))
+            f.write(v)
+    rt = read_tokenizer(str(path))
+    assert rt.vocab == vocab
+    assert rt.bos_id == 2
+    assert rt.eos_token_ids == [1]
+    assert rt.chat_template is None
