@@ -60,6 +60,20 @@ class TokenizerData:
         return len(self.vocab)
 
 
+def _read_vocab(f, vocab_size: int) -> tuple[list[bytes], list[float]]:
+    """Per-token (score, length, bytes) section shared by both header formats
+    (reference: src/tokenizer.cpp:125-136)."""
+    vocab: list[bytes] = []
+    scores: list[float] = []
+    for _ in range(vocab_size):
+        score, length = struct.unpack("<fi", f.read(8))
+        if length < 1:
+            raise ValueError(f"invalid token length: {length}")
+        vocab.append(f.read(length))
+        scores.append(score)
+    return vocab, scores
+
+
 def read_tokenizer(path: str) -> TokenizerData:
     """Parse a `.t` file (reference: src/tokenizer.cpp:42-164)."""
     with open(path, "rb") as f:
@@ -120,12 +134,7 @@ def read_tokenizer(path: str) -> TokenizerData:
         if max_token_length < 1:
             raise ValueError("invalid tokenizer max token length")
 
-        vocab: list[bytes] = []
-        scores: list[float] = []
-        for _ in range(vocab_size):
-            score, length = struct.unpack("<fi", f.read(8))
-            vocab.append(f.read(length))
-            scores.append(score)
+        vocab, scores = _read_vocab(f, vocab_size)
 
     return TokenizerData(
         vocab=vocab,
@@ -147,12 +156,7 @@ def _read_old_tokenizer(f) -> TokenizerData:
     )
     if max_token_length < 1:
         raise ValueError("invalid tokenizer max token length")
-    vocab: list[bytes] = []
-    scores: list[float] = []
-    for _ in range(vocab_size):
-        score, length = struct.unpack("<fi", f.read(8))
-        vocab.append(f.read(length))
-        scores.append(score)
+    vocab, scores = _read_vocab(f, vocab_size)
     return TokenizerData(
         vocab=vocab,
         scores=scores,

@@ -1,0 +1,4 @@
+from .transformer import forward, init_kv_cache, Params, KvCache
+from .loader import load_params
+
+__all__ = ["forward", "init_kv_cache", "load_params", "Params", "KvCache"]

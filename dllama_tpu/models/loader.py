@@ -1,0 +1,106 @@
+"""Load `.m` weights into the transformer's params pytree.
+
+TPU-native counterpart of the reference's weight loading + distribution
+(loadLlmNetWeight, src/llm.cpp:614-669): the reference root slices every
+matmul weight per node and ships slices over TCP; here each tensor is read
+(streamed via memmap), transposed to the [in, out] matmul layout, stacked
+across layers for `lax.scan`, and `jax.device_put` with a NamedSharding does
+the slicing — XLA/ICI plays the role of the socket loader.
+
+Llama q/k row permutation note: the converter pre-permutes q/k rows to the
+interleaved-rope layout (converter/convert-hf.py:13-16), so like the
+reference we consume the file as-is and use interleaved RoPE for llama.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..formats.model_file import LlmArch, LlmHeader, ModelReader
+from ..ops.jnp_ops import rope_cache
+from .transformer import Params
+
+# Placement hook: receives (name, np array) and returns the device array.
+# The TP engine passes a function that applies the right NamedSharding;
+# default is plain device_put semantics via jnp.asarray.
+PutFn = Callable[[str, np.ndarray], jnp.ndarray]
+
+
+def _default_put(name: str, arr: np.ndarray) -> jnp.ndarray:
+    return jnp.asarray(arr)
+
+
+def load_params(
+    reader: ModelReader,
+    dtype=jnp.float32,
+    put: PutFn = _default_put,
+) -> Params:
+    """Materialize the params pytree from a `.m` file.
+
+    `dtype` is the activation/matmul dtype for the dense (dequantized)
+    path — f32 for exactness tests, bf16 for TPU speed. Norm weights and
+    the rope cache stay f32. The quantized (planar int8) path is loaded by
+    the engine separately once the Pallas kernels are in play.
+    """
+    h = reader.header
+
+    def w(name: str, transpose: bool = True) -> np.ndarray:
+        a = reader.dense_f32(name)
+        if transpose:
+            a = np.ascontiguousarray(a.T)  # file is (out, in) -> we want (in, out)
+        return a
+
+    def stack(fn: Callable[[int], np.ndarray]) -> np.ndarray:
+        return np.stack([fn(l) for l in range(h.n_layers)])
+
+    layers: dict[str, jnp.ndarray] = {}
+    layers["att_norm"] = put(
+        "att_norm", stack(lambda l: w(f"layers.{l}.att_norm", False))
+    )
+    layers["ffn_norm"] = put(
+        "ffn_norm", stack(lambda l: w(f"layers.{l}.ffn_norm", False))
+    )
+    layers["wq"] = put("wq", stack(lambda l: w(f"layers.{l}.q")).astype(dtype))
+    layers["wk"] = put("wk", stack(lambda l: w(f"layers.{l}.k")).astype(dtype))
+    layers["wv"] = put("wv", stack(lambda l: w(f"layers.{l}.v")).astype(dtype))
+    layers["wo"] = put("wo", stack(lambda l: w(f"layers.{l}.wo")).astype(dtype))
+
+    if h.arch == LlmArch.QWEN3_MOE:
+        layers["moe_gate"] = put(
+            "moe_gate", stack(lambda l: w(f"layers.{l}.moe_gate"))
+        )
+
+        def experts(l: int, which: str) -> np.ndarray:
+            return np.stack(
+                [w(f"layers.{l}.experts.{e}.{which}") for e in range(h.n_experts)]
+            )
+
+        layers["w1"] = put("w1", stack(lambda l: experts(l, "w1")).astype(dtype))
+        layers["w2"] = put("w2", stack(lambda l: experts(l, "w2")).astype(dtype))
+        layers["w3"] = put("w3", stack(lambda l: experts(l, "w3")).astype(dtype))
+    else:
+        layers["w1"] = put("w1", stack(lambda l: w(f"layers.{l}.w1")).astype(dtype))
+        layers["w2"] = put("w2", stack(lambda l: w(f"layers.{l}.w2")).astype(dtype))
+        layers["w3"] = put("w3", stack(lambda l: w(f"layers.{l}.w3")).astype(dtype))
+
+    if h.arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE):
+        layers["q_norm"] = put(
+            "q_norm", stack(lambda l: w(f"layers.{l}.q_norm", False))
+        )
+        layers["k_norm"] = put(
+            "k_norm", stack(lambda l: w(f"layers.{l}.k_norm", False))
+        )
+
+    cos, sin = rope_cache(h)
+    params: Params = {
+        "embed": put("embed", reader.dense_f32("embed").astype(dtype)),
+        "wcls": put("wcls", w("wcls").astype(dtype)),
+        "final_norm": put("final_norm", w("final_norm", False)),
+        "rope_cos": put("rope_cos", np.asarray(cos)),
+        "rope_sin": put("rope_sin", np.asarray(sin)),
+        "layers": layers,
+    }
+    return params

@@ -1,0 +1,214 @@
+"""Pure-functional decoder forward pass for Llama / Qwen3 / Qwen3-MoE.
+
+This is the TPU-native re-design of the reference's graph builder
+(src/llm.cpp:151-605): where the reference emits an explicit per-node op
+graph (segments, pipes, sync steps) interpreted by a pthread executor, here
+the model is a single jit-traced function — XLA fuses what the reference
+scheduled by hand, and the reference's cross-node sync points (its
+SYNC_NODE_SLICES all-gather + OP_MERGE_ADD reduce = an all-reduce of the
+row/col-split matmul partial sums) become sharding constraints that make XLA
+insert `all-reduce` collectives over ICI (see parallel/sharding.py).
+
+Layer walk per token (reference: src/llm.cpp:263-557):
+    x += attn(rms_norm(x))     # q/k/v proj, [qk-norm,] rope, kv-cache, GQA attention, wo
+    x += ffn(rms_norm(x))      # swiglu w1/w3 -> w2, or MoE gate/topk/experts
+    logits = rms_norm(x) @ wcls
+
+Shapes: tokens [B, T] -> logits [B, T, V]. The reference is B=1 with T the
+prefill chunk (its `nBatches`); we keep a real batch axis as a data-parallel
+surface. The KV cache is [L, B, S, nKvHeads, headDim] — the kv-head axis is
+the tensor-parallel shard axis, mirroring the reference's KV split
+(sliceKvCache, src/nn/nn-core.cpp:211-218).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..formats.model_file import HiddenAct, LlmArch, LlmHeader, RopeType
+from ..ops.jnp_ops import apply_rope, gelu, qk_rms_norm, rms_norm, silu
+
+Params = Dict[str, Any]
+KvCache = Dict[str, jnp.ndarray]
+
+_NEG_INF = -1e30
+
+
+def init_kv_cache(
+    h: LlmHeader, batch_size: int, dtype=jnp.float32, seq_len: int | None = None
+) -> KvCache:
+    """Allocate the KV cache (reference allocates per-layer f32 k/v buffers,
+    src/llm.cpp:260-261)."""
+    s = seq_len or h.seq_len
+    shape = (h.n_layers, batch_size, s, h.n_kv_heads, h.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype=dtype),
+        "v": jnp.zeros(shape, dtype=dtype),
+    }
+
+
+def _attention(
+    q: jnp.ndarray,  # [B, T, H, hd]
+    k_cache: jnp.ndarray,  # [B, S, KH, hd]
+    v_cache: jnp.ndarray,  # [B, S, KH, hd]
+    pos: jnp.ndarray,  # scalar int32: absolute position of tokens[:, 0]
+    head_dim: int,
+) -> jnp.ndarray:
+    """Causal GQA attention over the full cache with position masking
+    (reference: multiheadAtt_F32, src/nn/nn-cpu-ops.cpp:753-788).
+
+    Grouped einsum keeps the kv-head axis explicit (no materialized
+    `repeat`): q is viewed as [B, T, KH, G, hd] where G = nHeads/nKvHeads
+    (the reference's `kvMul` GQA mapping).
+    """
+    b, t, n_heads, _ = q.shape
+    s = k_cache.shape[1]
+    kh = k_cache.shape[2]
+    g = n_heads // kh
+
+    qf = q.astype(jnp.float32).reshape(b, t, kh, g, head_dim)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+
+    scores = jnp.einsum("btkgh,bskh->bkgts", qf, kf) / jnp.sqrt(
+        jnp.float32(head_dim)
+    )
+    q_pos = pos + jnp.arange(t, dtype=jnp.int32)  # [T]
+    s_pos = jnp.arange(s, dtype=jnp.int32)  # [S]
+    mask = s_pos[None, :] <= q_pos[:, None]  # [T, S]
+    scores = jnp.where(mask[None, None, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskh->btkgh", probs, vf)
+    return out.reshape(b, t, n_heads * head_dim).astype(q.dtype)
+
+
+def _moe_ffn(
+    x: jnp.ndarray,  # [B, T, D]
+    gate_w: jnp.ndarray,  # [D, E]
+    w1: jnp.ndarray,  # [E, D, F]
+    w2: jnp.ndarray,  # [E, F, D]
+    w3: jnp.ndarray,  # [E, D, F]
+    n_active: int,
+    act,
+) -> jnp.ndarray:
+    """MoE FFN: softmax over all experts -> top-k -> normalized weights ->
+    weighted sum of expert SwiGLU outputs.
+
+    (reference: the OP_SOFTMAX / OP_MOE_GATE / 3x OP_MATMUL / OP_SCALE /
+    OP_MERGE_SUM chain, src/llm.cpp:425-499; gate math
+    src/nn/nn-cpu-ops.cpp:1462-1492 with normTopk=1.)
+
+    Routing is dense over experts (every expert computes, outputs are
+    masked by routing weight). That is compile-friendly and exact; the
+    gather/ragged fast path for decode lives in the engine's step function
+    once the Pallas ragged kernel lands (SURVEY.md §7 hard parts).
+    """
+    e = gate_w.shape[1]
+    logits = jnp.einsum(
+        "btd,de->bte", x.astype(jnp.float32), gate_w.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [B, T, E]
+    top_p, top_i = lax.top_k(probs, n_active)  # [B, T, k]
+    weights = top_p / jnp.sum(top_p, axis=-1, keepdims=True)  # normTopk=1
+
+    # routing matrix [B, T, E]: normalized weight where selected, else 0
+    routing = jnp.sum(
+        jax.nn.one_hot(top_i, e, dtype=jnp.float32) * weights[..., None], axis=2
+    )
+
+    h1 = jnp.einsum("btd,edf->btef", x, w1)
+    h3 = jnp.einsum("btd,edf->btef", x, w3)
+    hidden = act(h1) * h3.astype(h1.dtype)
+    expert_out = jnp.einsum("btef,efd->bted", hidden, w2)
+    out = jnp.einsum(
+        "bted,bte->btd", expert_out.astype(jnp.float32), routing
+    )
+    return out.astype(x.dtype)
+
+
+def forward(
+    params: Params,
+    h: LlmHeader,
+    tokens: jnp.ndarray,  # [B, T] int32
+    pos: jnp.ndarray,  # scalar int32
+    cache: KvCache,
+) -> Tuple[jnp.ndarray, KvCache]:
+    """Run the decoder on T tokens starting at absolute position `pos`.
+
+    Returns (logits [B, T, V] f32, updated cache). Jit-safe: T is static,
+    `pos` is a traced scalar. Layers run under `lax.scan` over the stacked
+    layer parameters so compile time is O(1) in depth.
+    """
+    b, t = tokens.shape
+    interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
+    act = silu if h.hidden_act == HiddenAct.SILU else gelu
+    is_qwen3 = h.arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE)
+
+    x = params["embed"][tokens]  # [B, T, D] (reference: OP_EMBEDDING)
+
+    cos = lax.dynamic_slice_in_dim(params["rope_cos"], pos, t, axis=0)  # [T, hd/2]
+    sin = lax.dynamic_slice_in_dim(params["rope_sin"], pos, t, axis=0)
+
+    def layer_step(x, layer):
+        lp, k_cache_l, v_cache_l = layer
+
+        # -- attention block (reference: src/llm.cpp:263-403) --
+        y = rms_norm(x, lp["att_norm"], h.norm_epsilon)
+        q = jnp.einsum("btd,dq->btq", y, lp["wq"]).reshape(
+            b, t, h.n_heads, h.head_dim
+        )
+        k = jnp.einsum("btd,dk->btk", y, lp["wk"]).reshape(
+            b, t, h.n_kv_heads, h.head_dim
+        )
+        v = jnp.einsum("btd,dk->btk", y, lp["wv"]).reshape(
+            b, t, h.n_kv_heads, h.head_dim
+        )
+        if is_qwen3:
+            q = qk_rms_norm(q, lp["q_norm"], h.norm_epsilon)
+            k = qk_rms_norm(k, lp["k_norm"], h.norm_epsilon)
+        q = apply_rope(q, cos, sin, interleaved)
+        k = apply_rope(k, cos, sin, interleaved)
+
+        # KV-cache append at position (reference: OP_SHIFT,
+        # src/nn/nn-cpu-ops.cpp:1419-1441) -> dynamic_update_slice.
+        k_cache_l = lax.dynamic_update_slice_in_dim(
+            k_cache_l, k.astype(k_cache_l.dtype), pos, axis=1
+        )
+        v_cache_l = lax.dynamic_update_slice_in_dim(
+            v_cache_l, v.astype(v_cache_l.dtype), pos, axis=1
+        )
+
+        z = _attention(q, k_cache_l, v_cache_l, pos, h.head_dim)
+        x = x + jnp.einsum("btq,qd->btd", z, lp["wo"]).astype(x.dtype)
+
+        # -- FFN block (reference: src/llm.cpp:405-557) --
+        y = rms_norm(x, lp["ffn_norm"], h.norm_epsilon)
+        if h.arch == LlmArch.QWEN3_MOE:
+            f = _moe_ffn(
+                y,
+                lp["moe_gate"],
+                lp["w1"],
+                lp["w2"],
+                lp["w3"],
+                h.n_active_experts,
+                act,
+            )
+        else:
+            d = act(jnp.einsum("btd,df->btf", y, lp["w1"]))
+            l = jnp.einsum("btd,df->btf", y, lp["w3"])
+            f = jnp.einsum("btf,fd->btd", d * l.astype(d.dtype), lp["w2"])
+        x = x + f.astype(x.dtype)
+        return x, (k_cache_l, v_cache_l)
+
+    x, (k_new, v_new) = lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"])
+    )
+
+    # final norm + logits (reference: src/llm.cpp:560-599)
+    y = rms_norm(x, params["final_norm"], h.norm_epsilon)
+    logits = jnp.einsum("btd,dv->btv", y.astype(jnp.float32), params["wcls"].astype(jnp.float32))
+    return logits, {"k": k_new, "v": v_new}

@@ -1,0 +1,19 @@
+from .jnp_ops import (
+    rms_norm,
+    qk_rms_norm,
+    silu,
+    gelu,
+    rope_frequencies,
+    rope_cache,
+    apply_rope,
+)
+
+__all__ = [
+    "rms_norm",
+    "qk_rms_norm",
+    "silu",
+    "gelu",
+    "rope_frequencies",
+    "rope_cache",
+    "apply_rope",
+]

@@ -1,0 +1,131 @@
+"""Reference jnp implementations of the model ops.
+
+These are the semantic twins of the reference's CPU kernels
+(src/nn/nn-cpu-ops.cpp); the Pallas kernels in ops/pallas/* are validated
+against them (the same cross-implementation equivalence strategy the
+reference uses for SIMD vs scalar and Vulkan vs CPU — SURVEY.md §4).
+
+Everything here is shape-polymorphic jnp, jit-safe, and f32-accumulating:
+norms, RoPE and softmax stay in f32 regardless of the activation dtype,
+matching the reference numerics (all its kernels accumulate in f32).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..formats.model_file import LlmHeader, RopeType
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """RMS norm over the last axis (reference: OP_INV_RMS + OP_RMS_NORM,
+    src/nn/nn-cpu-ops.cpp:114-189 — the reference splits the inverse-rms
+    reduce from the scale so one reduce can feed several columns; under XLA
+    that split is fusion, not an op boundary)."""
+    xf = x.astype(jnp.float32)
+    inv = jnp.reciprocal(jnp.sqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps))
+    return (xf * inv * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def qk_rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """Per-head RMS norm for Qwen3 QK-norm: ``x`` is [..., nHeads, headDim],
+    ``weight`` is [headDim] (reference: the nQNormColumns-column variant of
+    OP_INV_RMS/OP_RMS_NORM, src/llm.cpp:322-346)."""
+    return rms_norm(x, weight, eps)
+
+
+def silu(x: jnp.ndarray) -> jnp.ndarray:
+    """(reference: src/nn/nn-cpu-ops.cpp:454-478)"""
+    xf = x.astype(jnp.float32)
+    return (xf / (1.0 + jnp.exp(-xf))).astype(x.dtype)
+
+
+def gelu(x: jnp.ndarray) -> jnp.ndarray:
+    """tanh-approx GELU (reference: gelu_F32, src/nn/nn-cpu-ops.cpp:480-500)."""
+    xf = x.astype(jnp.float32)
+    return (
+        0.5
+        * xf
+        * (1.0 + jnp.tanh(0.797884560802865 * (xf + 0.044715 * xf * xf * xf)))
+    ).astype(x.dtype)
+
+
+def _scale_frequency_llama3(freq: jnp.ndarray, h: LlmHeader) -> jnp.ndarray:
+    """Llama-3.1 NTK-by-parts frequency scaling
+    (reference: src/nn/nn-core.cpp:326-340)."""
+    wave_len = 2.0 * jnp.pi / freq
+    high_freq_wavelen = h.rope_scaling_orig_max_seq_len / h.rope_scaling_high_freq_factor
+    low_freq_wavelen = h.rope_scaling_orig_max_seq_len / h.rope_scaling_low_freq_factor
+    smooth = (h.rope_scaling_orig_max_seq_len / wave_len - h.rope_scaling_low_freq_factor) / (
+        h.rope_scaling_high_freq_factor - h.rope_scaling_low_freq_factor
+    )
+    scaled = jnp.where(
+        wave_len < high_freq_wavelen,
+        freq,
+        jnp.where(
+            wave_len > low_freq_wavelen,
+            freq / h.rope_scaling_factor,
+            (1.0 - smooth) * freq / h.rope_scaling_factor + smooth * freq,
+        ),
+    )
+    return scaled
+
+
+def rope_frequencies(h: LlmHeader) -> jnp.ndarray:
+    """Per-pair inverse frequencies, shape [headDim // 2], f32.
+
+    The reference computes ``theta^{-(i % headDim)/headDim}`` for even i
+    (llama layout, src/nn/nn-core.cpp:342-359) and ``theta^{-2j/headDim}``
+    for the falcon layout (src/nn/nn-core.cpp:361-374) — identical values,
+    different pairing; the pairing lives in `apply_rope`.
+    """
+    half = h.head_dim // 2
+    exponents = 2.0 * jnp.arange(half, dtype=jnp.float32) / h.head_dim
+    freqs = 1.0 / (h.rope_theta**exponents)
+    if h.rope_type == RopeType.LLAMA3_1 and h.rope_scaling_factor != 1.0:
+        freqs = _scale_frequency_llama3(freqs, h)
+    return freqs
+
+
+def rope_cache(h: LlmHeader, seq_len: int | None = None) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(cos, sin) tables of shape [seqLen, headDim // 2]
+    (reference: fullfillRopeCache, src/nn/nn-core.cpp:376-383)."""
+    if seq_len is None:
+        seq_len = h.seq_len
+    freqs = rope_frequencies(h)
+    angles = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    interleaved: bool,
+) -> jnp.ndarray:
+    """Rotate ``x`` of shape [..., T, nHeads, headDim] by position.
+
+    ``cos``/``sin`` are [T, headDim//2] rows for the absolute positions of
+    the T axis. ``interleaved=True`` pairs (2j, 2j+1) — the llama layout the
+    converter permutes q/k for (reference: ropeLlama_F32,
+    src/nn/nn-cpu-ops.cpp:843-863); ``False`` pairs (j, j+headDim/2) — the
+    falcon/neox layout used by Qwen3 (src/nn/nn-cpu-ops.cpp:865-885).
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    c = cos[:, None, :]  # [T, 1, half]
+    s = sin[:, None, :]
+    if interleaved:
+        x0 = xf[..., 0::2]
+        x1 = xf[..., 1::2]
+        r0 = x0 * c - x1 * s
+        r1 = x0 * s + x1 * c
+        out = jnp.stack([r0, r1], axis=-1).reshape(xf.shape)
+    else:
+        half = xf.shape[-1] // 2
+        x0 = xf[..., :half]
+        x1 = xf[..., half:]
+        r0 = x0 * c - x1 * s
+        r1 = x0 * s + x1 * c
+        out = jnp.concatenate([r0, r1], axis=-1)
+    return out.astype(dtype)

@@ -55,12 +55,12 @@ class Tokenizer:
         """Exact regular-vocab lookup (reference: src/tokenizer.cpp:206-210)."""
         return self._regular.get(piece, -1)
 
-    def find_special_token_start_with(self, text: bytes) -> int:
-        """First special token that prefixes `text`, scanned in id order
-        (reference: src/tokenizer.cpp:196-204)."""
+    def find_special_token_start_with(self, text: bytes, start: int = 0) -> int:
+        """First special token that prefixes ``text[start:]``, scanned in id
+        order (reference: src/tokenizer.cpp:196-204). Offset-based to avoid
+        copying a tail slice per byte position."""
         for tid in self._special_ids:
-            tok = self.vocab[tid]
-            if text.startswith(tok):
+            if text.startswith(self.vocab[tid], start):
                 return tid
         return -1
 
@@ -79,26 +79,20 @@ class Tokenizer:
         if is_start and self.add_bos and self.bos_id >= 0:
             tokens.append(self.bos_id)
 
-        # Greedy byte accumulation; specials matched by prefix at each position.
+        # Greedy byte accumulation; specials matched by prefix at every byte
+        # position — even mid-accumulation, in which case the special is
+        # emitted and accumulation continues across it, exactly as the
+        # reference does (src/tokenizer.cpp:325-333).
         acc = bytearray()
         i = 0
         n = len(raw)
         while i < n:
-            if add_special_tokens and not acc:
-                sid = self.find_special_token_start_with(raw[i:])
+            if add_special_tokens:
+                sid = self.find_special_token_start_with(raw, i)
                 if sid >= 0:
                     tokens.append(sid)
                     i += len(self.vocab[sid])
                     continue
-            elif add_special_tokens and acc:
-                sid = self.find_special_token_start_with(raw[i:])
-                if sid >= 0:
-                    # The reference checks specials at every byte position even
-                    # mid-accumulation (src/tokenizer.cpp:325-333); a dangling
-                    # accumulation there would trip its assert. Match that.
-                    raise ValueError(
-                        f"un-tokenizable byte span before special token: {bytes(acc)!r}"
-                    )
             acc.append(raw[i])
             i += 1
             tid = self.find_regular_token(bytes(acc))
@@ -153,7 +147,9 @@ class Tokenizer:
         return out if out else None
 
     def decode_tokens(self, tokens: list[int]) -> str:
-        """Non-streaming convenience: decode a whole sequence."""
+        """Non-streaming convenience: decode a whole sequence. Starts from a
+        clean decoder so stale streaming state cannot leak in."""
+        self.reset_decoder()
         parts = []
         for t in tokens:
             s = self.decode(t)

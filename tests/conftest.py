@@ -14,3 +14,9 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402  (after the env setup above, by design)
+
+# f32 matmuls must really be f32 for oracle-equivalence tests (this JAX
+# build's default matmul precision is reduced even on CPU).
+jax.config.update("jax_default_matmul_precision", "highest")

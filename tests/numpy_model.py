@@ -1,0 +1,140 @@
+"""Independent numpy oracle for the transformer forward pass.
+
+Deliberately written loop-style and directly from the reference kernel
+semantics (src/nn/nn-cpu-ops.cpp) — NOT by calling into dllama_tpu's model
+code — so tests compare two independent implementations, mirroring the
+reference's SIMD-vs-scalar / GPU-vs-CPU equivalence testing (SURVEY.md §4).
+Consumes file-layout tensors: matmul weights are (out, in) and y = W @ x.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dllama_tpu.formats.model_file import LlmArch, LlmHeader, RopeType
+
+
+def rmsnorm(x: np.ndarray, w: np.ndarray, eps: float) -> np.ndarray:
+    inv = 1.0 / np.sqrt(np.mean(x * x, axis=-1, keepdims=True) + eps)
+    return x * inv * w
+
+
+def softmax(x: np.ndarray) -> np.ndarray:
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def silu(x):
+    return x / (1.0 + np.exp(-x))
+
+
+def rope_rotate(vec: np.ndarray, pos: int, h: LlmHeader) -> np.ndarray:
+    """Rotate one [nHeads*headDim] row in place-style; llama interleaved or
+    falcon half-rotation pairing (src/nn/nn-cpu-ops.cpp:843-885)."""
+    hd = h.head_dim
+    half = hd // 2
+    out = vec.copy().reshape(-1, hd)
+    freqs = 1.0 / (h.rope_theta ** (2.0 * np.arange(half) / hd))
+    if h.rope_type == RopeType.LLAMA3_1 and h.rope_scaling_factor != 1.0:
+        scaled = []
+        for f in freqs:
+            wave_len = 2.0 * np.pi / f
+            high = h.rope_scaling_orig_max_seq_len / h.rope_scaling_high_freq_factor
+            low = h.rope_scaling_orig_max_seq_len / h.rope_scaling_low_freq_factor
+            if wave_len < high:
+                scaled.append(f)
+            elif wave_len > low:
+                scaled.append(f / h.rope_scaling_factor)
+            else:
+                smooth = (
+                    h.rope_scaling_orig_max_seq_len / wave_len
+                    - h.rope_scaling_low_freq_factor
+                ) / (h.rope_scaling_high_freq_factor - h.rope_scaling_low_freq_factor)
+                scaled.append((1 - smooth) * f / h.rope_scaling_factor + smooth * f)
+        freqs = np.array(scaled)
+    cos = np.cos(pos * freqs)
+    sin = np.sin(pos * freqs)
+    interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
+    for head in range(out.shape[0]):
+        row = out[head]
+        if interleaved:
+            for j in range(half):
+                v0, v1 = row[2 * j], row[2 * j + 1]
+                row[2 * j] = v0 * cos[j] - v1 * sin[j]
+                row[2 * j + 1] = v0 * sin[j] + v1 * cos[j]
+        else:
+            for j in range(half):
+                v0, v1 = row[j], row[j + half]
+                row[j] = v0 * cos[j] - v1 * sin[j]
+                row[j + half] = v0 * sin[j] + v1 * cos[j]
+    return out.reshape(vec.shape)
+
+
+def numpy_forward(
+    tensors: dict[str, np.ndarray], h: LlmHeader, tokens: list[int]
+) -> np.ndarray:
+    """Full forward over a token list (single sequence); returns [T, V] f32."""
+    hd = h.head_dim
+    n_heads, n_kv = h.n_heads, h.n_kv_heads
+    kv_mul = n_heads // n_kv
+    is_qwen3 = h.arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE)
+
+    x = np.stack([tensors["embed"][t].astype(np.float64) for t in tokens])
+    k_cache = [np.zeros((len(tokens), n_kv, hd)) for _ in range(h.n_layers)]
+    v_cache = [np.zeros((len(tokens), n_kv, hd)) for _ in range(h.n_layers)]
+
+    logits_rows = []
+    for t, _tok in enumerate(tokens):
+        xt = x[t]
+        for l in range(h.n_layers):
+            pre = f"layers.{l}."
+            y = rmsnorm(xt, tensors[pre + "att_norm"], h.norm_epsilon)
+            q = tensors[pre + "q"] @ y
+            k = tensors[pre + "k"] @ y
+            v = tensors[pre + "v"] @ y
+            if is_qwen3:
+                q = rmsnorm(
+                    q.reshape(n_heads, hd), tensors[pre + "q_norm"], h.norm_epsilon
+                ).reshape(-1)
+                k = rmsnorm(
+                    k.reshape(n_kv, hd), tensors[pre + "k_norm"], h.norm_epsilon
+                ).reshape(-1)
+            q = rope_rotate(q, t, h)
+            k = rope_rotate(k, t, h)
+            k_cache[l][t] = k.reshape(n_kv, hd)
+            v_cache[l][t] = v.reshape(n_kv, hd)
+
+            z = np.zeros(n_heads * hd)
+            qh = q.reshape(n_heads, hd)
+            for head in range(n_heads):
+                kv_head = head // kv_mul
+                scores = np.array(
+                    [
+                        qh[head] @ k_cache[l][s, kv_head] / np.sqrt(hd)
+                        for s in range(t + 1)
+                    ]
+                )
+                att = softmax(scores)
+                z[head * hd : (head + 1) * hd] = sum(
+                    att[s] * v_cache[l][s, kv_head] for s in range(t + 1)
+                )
+            xt = xt + tensors[pre + "wo"] @ z
+
+            y = rmsnorm(xt, tensors[pre + "ffn_norm"], h.norm_epsilon)
+            if h.arch == LlmArch.QWEN3_MOE:
+                gate_logits = tensors[pre + "moe_gate"] @ y
+                probs = softmax(gate_logits)
+                top = np.argsort(-probs)[: h.n_active_experts]
+                wsum = probs[top].sum()
+                f = np.zeros_like(y)
+                for e in top:
+                    ep = f"{pre}experts.{e}."
+                    d = silu(tensors[ep + "w1"] @ y) * (tensors[ep + "w3"] @ y)
+                    f += (probs[e] / wsum) * (tensors[ep + "w2"] @ d)
+            else:
+                d = silu(tensors[pre + "w1"] @ y) * (tensors[pre + "w3"] @ y)
+                f = tensors[pre + "w2"] @ d
+            xt = xt + f
+        y = rmsnorm(xt, tensors["final_norm"], h.norm_epsilon)
+        logits_rows.append(tensors["wcls"] @ y)
+    return np.stack(logits_rows).astype(np.float32)
