@@ -1,0 +1,100 @@
+"""Decode-throughput benchmark. Prints ONE JSON line on stdout.
+
+Measures single-stream greedy decode tokens/sec on a Llama-3.2-1B-shaped
+model (BASELINE.json config #1) with bf16 weights, on whatever devices the
+runtime exposes (the driver runs this on one real TPU chip).
+
+vs_baseline: ratio against the reference's best published decode rate,
+2.02 tok/s (Llama 2 7B on 4x RPi 4B — BASELINE.md; its only in-repo
+numbers; no 1B figures exist). Cross-hardware/model orientation only.
+
+Env knobs: BENCH_PRESET (default llama-1b), BENCH_STEPS, BENCH_TP.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_BEST_TOK_S = 2.02
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dllama_tpu.models import forward, init_kv_cache
+    from dllama_tpu.models.synthetic import make_header, random_params
+    from dllama_tpu.parallel import cache_specs, make_mesh
+
+    preset = os.environ.get("BENCH_PRESET", "llama-1b")
+    steps = int(os.environ.get("BENCH_STEPS", "64"))
+    tp = int(os.environ.get("BENCH_TP", "0")) or 1
+    seq_len = int(os.environ.get("BENCH_SEQ_LEN", "1024"))
+
+    h = make_header(preset, max_seq_len=seq_len)
+    log(f"bench: {preset}, tp={tp}, steps={steps}, seq_len={h.seq_len}, "
+        f"devices={jax.devices()}")
+
+    mesh = make_mesh(tp=tp)
+    t0 = time.perf_counter()
+    params = random_params(h, dtype=jnp.bfloat16, mesh=mesh)
+    cache = init_kv_cache(h, batch_size=1, dtype=jnp.bfloat16)
+    cspecs = cache_specs(h)
+    cache = {
+        k: jax.device_put(v, NamedSharding(mesh, cspecs[k])) for k, v in cache.items()
+    }
+    jax.block_until_ready(params["layers"]["wq"])
+    log(f"params built in {time.perf_counter() - t0:.1f}s")
+
+    @partial(jax.jit, donate_argnums=(2,))
+    def decode(params, token, cache, pos):
+        logits, cache = forward(params, h, token, pos, cache)
+        return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), cache
+
+    token_sharding = NamedSharding(mesh, P(None, None))
+    tok = jax.device_put(jnp.asarray([[1]], dtype=jnp.int32), token_sharding)
+
+    # warmup / compile
+    t0 = time.perf_counter()
+    out, cache = decode(params, tok, cache, jnp.int32(0))
+    jax.block_until_ready(out)
+    log(f"compile+first step: {time.perf_counter() - t0:.1f}s")
+
+    # timed decode loop; keep the token on device end-to-end
+    t0 = time.perf_counter()
+    pos = 1
+    for i in range(steps):
+        tok = out.reshape(1, 1)
+        out, cache = decode(params, tok, cache, jnp.int32(pos))
+        pos += 1
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    tok_s = steps / dt
+    per_chip = tok_s / tp
+    log(f"{steps} decode steps in {dt:.2f}s -> {tok_s:.2f} tok/s "
+        f"({per_chip:.2f}/chip)")
+
+    print(
+        json.dumps(
+            {
+                "metric": f"decode_tok_s_per_chip_{preset.replace('-', '_')}_bf16",
+                "value": round(per_chip, 2),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(per_chip / REFERENCE_BEST_TOK_S, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,302 @@
+"""dllama-compatible CLI: inference / chat / perplexity modes.
+
+Keeps the reference's flag surface (src/app.cpp:24-135) so a
+distributed-llama user can switch with the same command lines, with
+TPU-native replacements where the concept changed:
+
+    --workers h:p ...   ->  --tp N      (chips on the slice, not LAN hosts;
+                                         --workers N is accepted as an alias)
+    --nthreads          ->  accepted, ignored (XLA owns threading)
+    --buffer-float-type ->  accepted (sync compression is moot over ICI)
+    --gpu-index/--gpu-segments -> rejected (the TPU *is* the device)
+
+Per-token timing surface mirrors dllama.cpp:59-66,88-95 (Eval/Pred + Sync
+per line, tokens/s summary blocks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dllama-tpu",
+        description="TPU-native distributed-llama: tensor-parallel LLM inference",
+    )
+    p.add_argument("mode", choices=["inference", "chat", "perplexity", "worker"])
+    p.add_argument("--model", required=False)
+    p.add_argument("--tokenizer", required=False)
+    p.add_argument("--prompt", default=None)
+    p.add_argument("--steps", type=int, default=0)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--topp", type=float, default=0.9)
+    p.add_argument("--seed", type=int, default=int(time.time()))
+    p.add_argument("--max-seq-len", type=int, default=0)
+    p.add_argument("--buffer-float-type", default="q80", help="accepted for CLI parity; ICI needs no sync compression")
+    p.add_argument("--nthreads", type=int, default=1, help="accepted for CLI parity; XLA owns threading")
+    p.add_argument("--net-turbo", type=int, default=1, help="accepted for CLI parity")
+    p.add_argument("--nbatches", "--n-batches", type=int, default=32, dest="nbatches", help="prefill chunk size")
+    p.add_argument("--tp", type=int, default=0, help="tensor-parallel chips (default: all)")
+    p.add_argument("--workers", nargs="*", default=None, help="alias for --tp: pass a chip count (host:port lists are a LAN-cluster concept)")
+    p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--kv-dtype", default=None, choices=[None, "bf16", "f32"])
+    p.add_argument("--chat-template", default=None, choices=[None, "llama2", "llama3", "deepSeek3", "chatml"])
+    p.add_argument("--gpu-index", type=int, default=None)
+    p.add_argument("--gpu-segments", default=None)
+    return p
+
+
+def _resolve_tp(args) -> int:
+    if args.gpu_index is not None or args.gpu_segments is not None:
+        raise SystemExit(
+            "--gpu-index/--gpu-segments are Vulkan-backend options; on TPU "
+            "the accelerator is the only device (use --tp to scale chips)"
+        )
+    if args.tp:
+        return args.tp
+    if args.workers:
+        if len(args.workers) == 1 and args.workers[0].isdigit():
+            return int(args.workers[0])
+        # host:port lists: map N workers -> N chips, like-for-like
+        print(
+            f"⚠️  --workers host:port lists are a LAN-cluster concept; using "
+            f"tp={len(args.workers)} chips over ICI instead"
+        )
+        return len(args.workers)
+    return 0  # auto: resolved against the model header in _load
+
+
+def _load(args):
+    import jax.numpy as jnp
+
+    from .runtime.engine import InferenceEngine
+    from .tokenizer import Tokenizer
+
+    if not args.model or not args.tokenizer:
+        raise SystemExit("--model and --tokenizer are required")
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    kv_dtype = None if args.kv_dtype is None else (
+        jnp.bfloat16 if args.kv_dtype == "bf16" else jnp.float32
+    )
+    tok = Tokenizer(args.tokenizer)
+    tp = _resolve_tp(args)
+    if tp == 0:
+        # auto: largest power of two that the device count AND the model's
+        # shardability constraints allow (mirrors the reference's
+        # nNodes <= nKvHeads rule, src/app.cpp:236-238)
+        from .formats import read_llm_header
+        from .parallel import validate_tp
+
+        h0 = read_llm_header(args.model)
+        tp = 1
+        while tp * 2 <= len(jax.devices()):
+            try:
+                validate_tp(h0, tp * 2)
+            except ValueError:
+                break
+            tp *= 2
+    engine = InferenceEngine(
+        args.model,
+        tokenizer=tok,
+        tp=tp,
+        dtype=dtype,
+        kv_dtype=kv_dtype,
+        max_seq_len=args.max_seq_len,
+        temperature=args.temperature,
+        topp=args.topp,
+        seed=args.seed,
+        prefill_buckets=tuple(sorted({1, args.nbatches, 512})),
+    )
+    h = engine.header
+    print(f"💡 Arch: {h.arch.name}")
+    print(f"💡 Dim: {h.dim}")
+    print(f"💡 HeadDim: {h.head_dim}")
+    print(f"💡 HiddenDim: {h.hidden_dim}")
+    print(f"💡 VocabSize: {h.vocab_size}")
+    print(f"💡 nLayers: {h.n_layers}")
+    print(f"💡 nHeads: {h.n_heads}")
+    print(f"💡 nKvHeads: {h.n_kv_heads}")
+    if h.n_experts:
+        print(f"💡 nExperts: {h.n_experts}")
+        print(f"💡 nActiveExperts: {h.n_active_experts}")
+    print(f"💡 SeqLen: {h.seq_len}")
+    print(f"💡 Tp: {tp} chip(s) [{jax.default_backend()}]")
+    tok.print_header()
+    return engine, tok
+
+
+def run_inference(args) -> None:
+    """(reference: dllama.cpp:13-116)"""
+    engine, tok = _load(args)
+    if args.prompt is None:
+        raise SystemExit("Prompt is required")
+    if args.steps == 0:
+        raise SystemExit("Number of steps is required")
+    tokens = tok.encode(args.prompt, is_start=True, add_special_tokens=True)
+    if len(tokens) > engine.header.seq_len:
+        raise SystemExit("The number of prompt tokens is greater than the sequence length")
+
+    print(args.prompt)
+    eval_stats = engine.prefill(tokens)
+    print(
+        f"🔷️ Eval{eval_stats.time_ms:5.0f} ms Sync    0 ms | "
+        f"Sent     0 kB Recv     0 kB | ({eval_stats.n_tokens} tokens)"
+    )
+    tok.reset_decoder()
+    pos = len(tokens) - 1
+    token = tokens[-1]
+    max_pos = min(engine.header.seq_len, args.steps)
+    pred_ms = 0.0
+    n_pred = 0
+    while pos < max_pos:
+        token, stats = engine.decode_step(token, pos)
+        pos += 1
+        pred_ms += stats.time_ms
+        n_pred += 1
+        piece = tok.decode(token)
+        print(
+            f"🔶 Pred{stats.time_ms:5.0f} ms Sync    0 ms | "
+            f"Sent     0 kB Recv     0 kB | {piece if piece is not None else '~'}"
+        )
+        sys.stdout.flush()
+
+    n_eval = max(len(tokens) - 1, 1)
+    print()
+    print("Evaluation")
+    print(f"   nBatches: {args.nbatches}")
+    print(f"    nTokens: {n_eval}")
+    print(
+        f"   tokens/s: {n_eval * 1000 / max(eval_stats.time_ms, 1e-9):3.2f} "
+        f"({eval_stats.time_ms / n_eval:3.2f} ms/tok)"
+    )
+    print("Prediction")
+    print(f"    nTokens: {n_pred}")
+    if n_pred:
+        print(
+            f"   tokens/s: {n_pred * 1000 / max(pred_ms, 1e-9):3.2f} "
+            f"({pred_ms / n_pred:3.2f} ms/tok)"
+        )
+
+
+def run_chat(args) -> None:
+    """Interactive REPL (reference: dllama.cpp:174-258)."""
+    from .tokenizer import ChatItem, ChatTemplateGenerator, ChatTemplateType, EosDetector, EosResult
+
+    engine, tok = _load(args)
+    eos_piece = (
+        tok.vocab[tok.eos_token_ids[0]].decode("utf-8", "replace")
+        if tok.eos_token_ids
+        else ""
+    )
+    ttype = ChatTemplateType.UNKNOWN
+    if args.chat_template:
+        ttype = {
+            "llama2": ChatTemplateType.LLAMA2,
+            "llama3": ChatTemplateType.LLAMA3,
+            "deepSeek3": ChatTemplateType.DEEP_SEEK3,
+            "chatml": ChatTemplateType.CHATML,
+        }[args.chat_template]
+    gen = ChatTemplateGenerator(ttype, tok.chat_template, eos_piece)
+    stops = [tok.vocab[t].decode("utf-8", "replace") for t in tok.eos_token_ids]
+    pos = 0
+    is_start = True
+    print("💬 Chat mode. Type your message (Ctrl-D to exit).")
+    while True:
+        try:
+            user = input("\n👱 You: ")
+        except EOFError:
+            break
+        if not user.strip():
+            continue
+        chat = gen.generate([ChatItem("user", user)], append_generation_prompt=True)
+        tokens = tok.encode(chat.content, is_start=is_start, add_special_tokens=True)
+        is_start = False
+        detector = EosDetector(
+            tok.eos_token_ids, stops, padding_left=2, padding_right=2
+        )
+        print("\n🤖 Assistant: ", end="", flush=True)
+        engine.prefill(tokens, pos=pos)
+        pos += len(tokens) - 1
+        token = tokens[-1]
+        tok.reset_decoder()
+        while pos < engine.header.seq_len - 1:
+            token, _ = engine.decode_step(token, pos)
+            pos += 1
+            piece = tok.decode(token)
+            res = detector.append(token, piece)
+            if res == EosResult.NOT_EOS:
+                delta = detector.get_delta()
+                if delta:
+                    print(delta, end="", flush=True)
+                detector.reset()
+            elif res == EosResult.EOS:
+                delta = detector.get_delta()
+                if delta:
+                    print(delta, end="", flush=True)
+                break
+        print()
+
+
+def run_perplexity(args) -> None:
+    """Teacher-forced NLL over the prompt — the numerical-quality oracle
+    (reference: dllama.cpp:132-172)."""
+    import numpy as np
+
+    engine, tok = _load(args)
+    if args.prompt is None:
+        raise SystemExit("Prompt is required")
+    tokens = tok.encode(args.prompt, is_start=True, add_special_tokens=True)
+    if len(tokens) < 2:
+        raise SystemExit("Prompt too short for perplexity")
+
+    # Run the full prompt through the model in one (bucketed) pass and score
+    # every next-token prediction.
+    import jax.numpy as jnp
+
+    from .models import forward, init_kv_cache
+
+    cache = engine._fresh_cache()
+    t = len(tokens)
+    arr = jnp.asarray([tokens] * engine.batch_size, dtype=jnp.int32)
+    logits, _ = forward(engine.params, engine.header, arr, jnp.int32(0), cache)
+    lg = np.asarray(logits, dtype=np.float32)[0]  # [T, V]
+    logprobs = lg - np.log(np.exp(lg - lg.max(-1, keepdims=True)).sum(-1, keepdims=True)) - lg.max(-1, keepdims=True)
+    nll = -np.mean([logprobs[i, tokens[i + 1]] for i in range(t - 1)])
+    ppl = float(np.exp(nll))
+    print(f"    nTokens: {t}")
+    print(f"        nll: {nll:.4f}")
+    print(f" perplexity: {ppl:.4f}")
+
+
+def main(argv=None) -> None:
+    import os
+
+    # This environment's TPU platform plugin wins over the JAX_PLATFORMS env
+    # var; re-assert the user's choice through the config API so
+    # `JAX_PLATFORMS=cpu` (e.g. the 8-virtual-device CPU harness) works.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    args = _build_parser().parse_args(argv)
+    if args.mode == "worker":
+        raise SystemExit(
+            "worker mode is a LAN-cluster concept: under SPMD every chip runs "
+            "the same program — launch the root command with --tp N instead "
+            "(multi-host: one identical launch per host, see "
+            "dllama_tpu.parallel.mesh.initialize_multihost)"
+        )
+    if args.mode == "inference":
+        run_inference(args)
+    elif args.mode == "chat":
+        run_chat(args)
+    elif args.mode == "perplexity":
+        run_perplexity(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,163 @@
+"""Synthetic model construction: headers + random params without a `.m` file.
+
+Used by bench.py, __graft_entry__.py and tests to exercise the full model
+path at arbitrary scale without multi-GB downloads. Shapes and pytree layout
+are identical to models/loader.load_params output.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..formats.model_file import HiddenAct, LlmArch, LlmHeader, RopeType
+from ..formats.quants import FloatType
+from ..ops.jnp_ops import rope_cache
+from .transformer import Params
+
+# Real-model shape presets (from the reference's supported model zoo,
+# launch.py:17-73 / BASELINE.json configs).
+PRESETS = {
+    "llama-1b": dict(
+        dim=2048, hidden_dim=8192, n_layers=16, n_heads=32, n_kv_heads=8,
+        head_dim=64, vocab_size=128256, seq_len=131072, rope_theta=500000.0,
+    ),
+    "llama-8b": dict(
+        dim=4096, hidden_dim=14336, n_layers=32, n_heads=32, n_kv_heads=8,
+        head_dim=128, vocab_size=128256, seq_len=131072, rope_theta=500000.0,
+    ),
+    "llama-70b": dict(
+        dim=8192, hidden_dim=28672, n_layers=80, n_heads=64, n_kv_heads=8,
+        head_dim=128, vocab_size=128256, seq_len=131072, rope_theta=500000.0,
+    ),
+    "qwen3-14b": dict(
+        dim=5120, hidden_dim=17408, n_layers=40, n_heads=40, n_kv_heads=8,
+        head_dim=128, vocab_size=151936, seq_len=40960, rope_theta=1000000.0,
+        arch=LlmArch.QWEN3,
+    ),
+    "qwen3-30b-a3b": dict(
+        dim=2048, hidden_dim=6144, moe_hidden_dim=768, n_layers=48,
+        n_heads=32, n_kv_heads=4, head_dim=128, vocab_size=151936,
+        seq_len=40960, rope_theta=1000000.0, arch=LlmArch.QWEN3_MOE,
+        n_experts=128, n_active_experts=8,
+    ),
+    "tiny": dict(
+        dim=64, hidden_dim=160, n_layers=2, n_heads=4, n_kv_heads=2,
+        head_dim=16, vocab_size=256, seq_len=64,
+    ),
+}
+
+
+def make_header(preset: str | dict, max_seq_len: int = 0) -> LlmHeader:
+    cfg = dict(PRESETS[preset]) if isinstance(preset, str) else dict(preset)
+    h = LlmHeader()
+    h.arch = cfg.pop("arch", LlmArch.LLAMA)
+    h.n_experts = cfg.pop("n_experts", 0)
+    h.n_active_experts = cfg.pop("n_active_experts", 0)
+    h.moe_hidden_dim = cfg.pop("moe_hidden_dim", 0)
+    h.rope_theta = cfg.pop("rope_theta", 10000.0)
+    for k, v in cfg.items():
+        setattr(h, k, v)
+    h.orig_seq_len = h.seq_len
+    if max_seq_len and h.seq_len > max_seq_len:
+        h.seq_len = max_seq_len
+    if h.head_dim == 0:
+        h.head_dim = h.dim // h.n_heads
+    h.hidden_act = HiddenAct.SILU
+    h.weight_type = FloatType.Q40
+    h.rope_type = (
+        RopeType.FALCON
+        if h.arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE)
+        else RopeType.LLAMA
+    )
+    h.norm_epsilon = 1e-5
+    return h
+
+
+def random_params(
+    h: LlmHeader,
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+    mesh=None,
+    put=None,  # kept for API symmetry with load_params; unused when mesh given
+) -> Params:
+    """Random params pytree with the loader's exact layout, generated
+    directly ON DEVICE (jit + out_shardings): no multi-GB host->device
+    transfer, which matters when the chip sits behind a slow tunnel.
+
+    Pass `mesh` to get TP-sharded parameters (same rules as
+    parallel.sharding.param_spec_tree)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    specs = None
+    if mesh is not None:
+        from ..parallel.sharding import param_spec_tree
+
+        specs = param_spec_tree(h)
+
+    root_key = jax.random.PRNGKey(seed)
+    scale = 0.02
+
+    def sharding_for(name):
+        if specs is None:
+            return None
+        spec = specs.get(name)
+        if spec is None:
+            spec = specs["layers"].get(name, PartitionSpec())
+        return NamedSharding(mesh, spec)
+
+    def mk(name, *shape, norm=False):
+        sh = sharding_for(name)
+        if norm:
+            f = jax.jit(
+                lambda: jnp.ones(shape, jnp.float32), out_shardings=sh
+            )
+            return f()
+        key = jax.random.fold_in(root_key, abs(hash(name)) % (2**31))
+        f = jax.jit(
+            lambda k: jax.random.normal(k, shape, dtype) * jnp.asarray(scale, dtype),
+            out_shardings=sh,
+        )
+        return f(key)
+
+    def dev(name, arr):
+        sh = sharding_for(name)
+        arr = jnp.asarray(arr)
+        return jax.device_put(arr, sh) if sh is not None else arr
+
+    L, D, HD = h.n_layers, h.dim, h.head_dim
+    QD, KD, FF, V = h.q_dim, h.kv_dim, h.ff_dim, h.vocab_size
+    moe = h.arch == LlmArch.QWEN3_MOE
+    E = h.n_experts
+
+    layers = {
+        "att_norm": mk("att_norm", L, D, norm=True),
+        "ffn_norm": mk("ffn_norm", L, D, norm=True),
+        "wq": mk("wq", L, D, QD),
+        "wk": mk("wk", L, D, KD),
+        "wv": mk("wv", L, D, KD),
+        "wo": mk("wo", L, QD, D),
+        "w1": mk("w1", L, E, D, FF) if moe else mk("w1", L, D, FF),
+        "w2": mk("w2", L, E, FF, D) if moe else mk("w2", L, FF, D),
+        "w3": mk("w3", L, E, D, FF) if moe else mk("w3", L, D, FF),
+    }
+    if moe:
+        gate_key = jax.random.fold_in(root_key, 12345)
+        layers["moe_gate"] = jax.jit(
+            lambda k: jax.random.normal(k, (L, D, E), jnp.float32) * scale,
+            out_shardings=sharding_for("moe_gate"),
+        )(gate_key)
+    if h.arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE):
+        layers["q_norm"] = mk("q_norm", L, HD, norm=True)
+        layers["k_norm"] = mk("k_norm", L, HD, norm=True)
+
+    cos, sin = rope_cache(h)
+    return {
+        "embed": mk("embed", V, D),
+        "wcls": mk("wcls", D, V),
+        "final_norm": mk("final_norm", D, norm=True),
+        "rope_cos": dev("rope_cos", cos),
+        "rope_sin": dev("rope_sin", sin),
+        "layers": layers,
+    }

@@ -13,6 +13,7 @@ matching the reference numerics (all its kernels accumulate in f32).
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..formats.model_file import LlmHeader, RopeType
 
@@ -50,29 +51,28 @@ def gelu(x: jnp.ndarray) -> jnp.ndarray:
     ).astype(x.dtype)
 
 
-def _scale_frequency_llama3(freq: jnp.ndarray, h: LlmHeader) -> jnp.ndarray:
+def _scale_frequency_llama3(freq: "np.ndarray", h: LlmHeader) -> "np.ndarray":
     """Llama-3.1 NTK-by-parts frequency scaling
     (reference: src/nn/nn-core.cpp:326-340)."""
-    wave_len = 2.0 * jnp.pi / freq
+    wave_len = 2.0 * np.pi / freq
     high_freq_wavelen = h.rope_scaling_orig_max_seq_len / h.rope_scaling_high_freq_factor
     low_freq_wavelen = h.rope_scaling_orig_max_seq_len / h.rope_scaling_low_freq_factor
     smooth = (h.rope_scaling_orig_max_seq_len / wave_len - h.rope_scaling_low_freq_factor) / (
         h.rope_scaling_high_freq_factor - h.rope_scaling_low_freq_factor
     )
-    scaled = jnp.where(
+    return np.where(
         wave_len < high_freq_wavelen,
         freq,
-        jnp.where(
+        np.where(
             wave_len > low_freq_wavelen,
             freq / h.rope_scaling_factor,
             (1.0 - smooth) * freq / h.rope_scaling_factor + smooth * freq,
         ),
     )
-    return scaled
 
 
-def rope_frequencies(h: LlmHeader) -> jnp.ndarray:
-    """Per-pair inverse frequencies, shape [headDim // 2], f32.
+def rope_frequencies(h: LlmHeader) -> "np.ndarray":
+    """Per-pair inverse frequencies, shape [headDim // 2], f32, on host.
 
     The reference computes ``theta^{-(i % headDim)/headDim}`` for even i
     (llama layout, src/nn/nn-core.cpp:342-359) and ``theta^{-2j/headDim}``
@@ -80,21 +80,25 @@ def rope_frequencies(h: LlmHeader) -> jnp.ndarray:
     different pairing; the pairing lives in `apply_rope`.
     """
     half = h.head_dim // 2
-    exponents = 2.0 * jnp.arange(half, dtype=jnp.float32) / h.head_dim
-    freqs = 1.0 / (h.rope_theta**exponents)
+    exponents = 2.0 * np.arange(half, dtype=np.float32) / np.float32(h.head_dim)
+    freqs = (1.0 / (h.rope_theta**exponents)).astype(np.float32)
     if h.rope_type == RopeType.LLAMA3_1 and h.rope_scaling_factor != 1.0:
-        freqs = _scale_frequency_llama3(freqs, h)
+        freqs = _scale_frequency_llama3(freqs, h).astype(np.float32)
     return freqs
 
 
-def rope_cache(h: LlmHeader, seq_len: int | None = None) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """(cos, sin) tables of shape [seqLen, headDim // 2]
-    (reference: fullfillRopeCache, src/nn/nn-core.cpp:376-383)."""
+def rope_cache(h: LlmHeader, seq_len: int | None = None):
+    """(cos, sin) host numpy tables of shape [seqLen, headDim // 2]
+    (reference: fullfillRopeCache, src/nn/nn-core.cpp:376-383).
+
+    Computed on host deliberately: the tables are load-time constants placed
+    by the loader's `put` hook, so building them on-device would just buy a
+    device->host->device round trip."""
     if seq_len is None:
         seq_len = h.seq_len
     freqs = rope_frequencies(h)
-    angles = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * freqs[None, :]
-    return jnp.cos(angles), jnp.sin(angles)
+    angles = np.arange(seq_len, dtype=np.float32)[:, None] * freqs[None, :]
+    return np.cos(angles), np.sin(angles)
 
 
 def apply_rope(

@@ -1,0 +1,11 @@
+from .mesh import make_mesh, validate_tp
+from .sharding import param_spec_tree, cache_specs, shard_params_put, named_sharding
+
+__all__ = [
+    "make_mesh",
+    "validate_tp",
+    "param_spec_tree",
+    "cache_specs",
+    "shard_params_put",
+    "named_sharding",
+]

@@ -1,0 +1,87 @@
+"""Device mesh construction for tensor/data parallel inference.
+
+TPU-native replacement for the reference's cluster topology: where the
+reference bootstraps a full TCP socket mesh of 2^n root+worker processes
+(NnNetwork::connect/serve, src/nn/nn-network.cpp:295-379) and ships op
+graphs to workers, here every chip runs the same SPMD program under one
+controller and the "topology" is a `jax.sharding.Mesh` whose collectives
+ride ICI (multi-host: DCN via `jax.distributed.initialize`, see
+`initialize_multihost`).
+
+Axes:
+    dp — data parallel over the batch axis (the reference has no DP;
+         surfaced here because it is free under SPMD)
+    tp — tensor parallel: matmul row/col splits, kv-head-split attention,
+         mirroring the reference's slicing (src/nn/nn-core.cpp:211-285)
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+from ..formats.model_file import LlmHeader
+
+
+def validate_tp(h: LlmHeader, tp: int) -> None:
+    """Mirror the reference's shardability constraints (src/app.cpp:236-240
+    requires nNodes ≤ nKvHeads and 2^n nodes; the dimension divisibility
+    asserts live in its slicers, src/nn/nn-core.cpp:211-243)."""
+    if tp < 1 or (tp & (tp - 1)) != 0:
+        raise ValueError(f"tp must be a power of two, got {tp}")
+    if tp > h.n_kv_heads:
+        raise ValueError(
+            f"tp={tp} exceeds nKvHeads={h.n_kv_heads} (the KV cache shards "
+            "by kv head, like the reference's sliceKvCache)"
+        )
+    for name, dim in [
+        ("dim", h.dim),
+        ("qDim", h.q_dim),
+        ("kvDim", h.kv_dim),
+        ("hiddenDim", h.ff_dim),
+        ("vocabSize", h.vocab_size),
+    ]:
+        if dim % tp != 0:
+            raise ValueError(f"{name}={dim} not divisible by tp={tp}")
+
+
+def make_mesh(tp: int = 1, dp: int = 1, devices=None) -> Mesh:
+    """Build a (dp, tp) mesh over the available devices.
+
+    Uses `jax.experimental.mesh_utils` device ordering so the tp axis maps
+    to physically adjacent chips (fastest ICI hops) on real TPU slices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n_needed = tp * dp
+    if n_needed > len(devices):
+        raise ValueError(
+            f"need {n_needed} devices (tp={tp} x dp={dp}), have {len(devices)}"
+        )
+    try:
+        from jax.experimental import mesh_utils
+
+        device_array = mesh_utils.create_device_mesh(
+            (dp, tp), devices=devices[:n_needed]
+        )
+    except Exception:
+        import numpy as np
+
+        device_array = np.asarray(devices[:n_needed]).reshape(dp, tp)
+    return Mesh(device_array, axis_names=("dp", "tp"))
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host (DCN) bootstrap — the SPMD analogue of the reference's
+    root/worker handshake (src/nn/nn-network.cpp:295-379). On a TPU pod
+    slice all arguments are auto-detected from the TPU metadata; elsewhere
+    pass them explicitly."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,92 @@
+"""Tensor-parallel sharding rules for the params pytree and KV cache.
+
+This module *is* the reference's TP design, restated declaratively:
+
+| reference mechanism (src/llm.cpp:168-176)          | PartitionSpec here |
+|----------------------------------------------------|--------------------|
+| sliceRowMatmul on q/k/v (out-dim split)            | wq/wk/wv: (.., "tp") last (out) axis |
+| sliceColMatmul on wo (in-dim split, partial sums)  | wo: ("tp", ..) in axis; XLA inserts the all-reduce the reference built from SYNC_NODE_SLICES + OP_MERGE_ADD |
+| sliceRowMatmul on w1/w3, sliceColMatmul on w2      | same pattern on the FFN |
+| sliceKvCache (kv-head split)                       | cache: kv-head axis over "tp" |
+| sliceMultiHeadAtt (head split)                     | falls out of the q/k/v out-shards |
+| sliceRowMatmul on wcls + logits gather-to-root     | wcls: vocab axis over "tp"; the gather is XLA's |
+| replicated norms/gates/embedding broadcast         | PartitionSpec() |
+
+The weight *splitters* (splitRowMatmulWeight etc., src/nn/nn-core.cpp:289-322)
+and the TCP weight shipping (NnRootWeightLoader) collapse into
+`jax.device_put(array, NamedSharding(mesh, spec))`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..formats.model_file import LlmArch, LlmHeader
+
+
+def param_spec_tree(h: LlmHeader) -> dict[str, Any]:
+    """PartitionSpecs matching the params pytree from models/loader.py."""
+    moe = h.arch == LlmArch.QWEN3_MOE
+    # stacked layer weights carry a leading layer axis; MoE adds an expert axis
+    row = P(None, None, None, "tp") if moe else P(None, None, "tp")  # out split
+    col = P(None, None, "tp", None) if moe else P(None, "tp", None)  # in split
+    layers: dict[str, Any] = {
+        "att_norm": P(),
+        "ffn_norm": P(),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "w1": row,
+        "w2": col,
+        "w3": row,
+    }
+    if moe:
+        layers["moe_gate"] = P()
+    if h.arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE):
+        layers["q_norm"] = P()
+        layers["k_norm"] = P()
+    return {
+        # The reference computes the embedding on the root node only and
+        # broadcasts X (SYNC_WITH_ROOT, src/llm.cpp:256); replicated under
+        # SPMD that broadcast is free.
+        "embed": P(),
+        "wcls": P(None, "tp"),
+        "final_norm": P(),
+        "rope_cos": P(),
+        "rope_sin": P(),
+        "layers": layers,
+    }
+
+
+def cache_specs(h: LlmHeader) -> dict[str, P]:
+    """KV cache [L, B, S, KH, hd]: batch over dp, kv-heads over tp
+    (reference: sliceKvCache, src/nn/nn-core.cpp:211-218)."""
+    spec = P(None, "dp", None, "tp", None)
+    return {"k": spec, "v": spec}
+
+
+def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def shard_params_put(mesh: Mesh, h: LlmHeader):
+    """A `put` hook for models/loader.load_params that places each tensor
+    with its TP sharding as it is read — per-shard streaming, so host
+    memory and per-device HBM stay at one slice per tensor (the TPU
+    equivalent of the reference's slice-by-slice socket streaming,
+    src/llm.cpp:614-669)."""
+    specs = param_spec_tree(h)
+    flat_layer_specs = specs["layers"]
+
+    def put(name: str, arr: np.ndarray):
+        spec = specs.get(name) if name in specs else flat_layer_specs.get(name)
+        if spec is None:
+            spec = P()
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return put

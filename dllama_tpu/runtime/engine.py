@@ -1,0 +1,227 @@
+"""Inference engine: jitted prefill/decode over a (dp, tp) mesh.
+
+TPU-native counterpart of the reference's runtime stack (NnExecutor +
+RootLlmInference/WorkerLlmInference, src/nn/nn-executor.cpp +
+src/app.cpp:170-230): the pthread step-list interpreter and the per-forward
+control-packet broadcast collapse into two jit-compiled XLA programs
+(prefill at a few bucketed chunk lengths, decode at T=1) with a donated KV
+cache. Sampling for the greedy path is fused on-device so the decode loop
+ships one int32 per token instead of a [vocab] logits row; the
+temperature/top-p path uses the reference-parity host sampler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..formats.model_file import LlmHeader, ModelReader
+from ..models import forward, init_kv_cache, load_params
+from ..parallel import cache_specs, make_mesh, shard_params_put, validate_tp
+from ..tokenizer import Tokenizer
+from .sampler import Sampler
+
+# Prefill chunk buckets: one compiled program per bucket (the reference's
+# --nBatches plays the same role: its graphs are compiled-in for nBatches
+# rows and prefill walks the prompt in nBatches-sized chunks).
+DEFAULT_PREFILL_BUCKETS = (1, 8, 32, 128, 512)
+
+
+@dataclasses.dataclass
+class StepStats:
+    """Per-forward timing surface (reference: dllama.cpp:59-66,88-95)."""
+
+    time_ms: float
+    n_tokens: int
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        model_path: str,
+        tokenizer: Tokenizer | None = None,
+        tp: int = 1,
+        dp: int = 1,
+        dtype=jnp.bfloat16,
+        kv_dtype=None,
+        max_seq_len: int = 0,
+        batch_size: int = 1,
+        temperature: float = 0.0,
+        topp: float = 0.9,
+        seed: int = 12345,
+        prefill_buckets: tuple[int, ...] = DEFAULT_PREFILL_BUCKETS,
+        matmul_precision: str | None = None,
+    ):
+        self.reader = ModelReader(model_path, max_seq_len=max_seq_len)
+        self.header: LlmHeader = self.reader.header
+        self.tokenizer = tokenizer
+        validate_tp(self.header, tp)
+        self.mesh = make_mesh(tp=tp, dp=dp)
+        self.tp, self.dp = tp, dp
+        self.batch_size = batch_size
+        self.dtype = dtype
+        self.kv_dtype = kv_dtype or dtype
+        self.sampler = Sampler(self.header.vocab_size, temperature, topp, seed)
+        self.temperature = temperature
+        self._precision = matmul_precision
+        self.prefill_buckets = tuple(
+            b for b in sorted(prefill_buckets) if b <= self.header.seq_len
+        ) or (1,)
+
+        self.params = load_params(
+            self.reader, dtype=dtype, put=shard_params_put(self.mesh, self.header)
+        )
+        self._cache_sharding = {
+            k: NamedSharding(self.mesh, spec)
+            for k, spec in cache_specs(self.header).items()
+        }
+        self.cache = self._fresh_cache()
+        self._token_sharding = NamedSharding(self.mesh, P("dp", None))
+        self._compiled = {}
+
+    # -- cache ---------------------------------------------------------------
+
+    def _fresh_cache(self):
+        cache = init_kv_cache(self.header, self.batch_size, dtype=self.kv_dtype)
+        return {
+            k: jax.device_put(v, self._cache_sharding[k]) for k, v in cache.items()
+        }
+
+    def reset(self) -> None:
+        """Drop KV state (new conversation)."""
+        self.cache = self._fresh_cache()
+
+    # -- compiled steps ------------------------------------------------------
+
+    def _step_fn(self, t: int, greedy: bool):
+        """Build/jit the forward step for chunk length `t`."""
+        key = (t, greedy)
+        if key in self._compiled:
+            return self._compiled[key]
+        h = self.header
+        precision = self._precision
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def step(params, tokens, cache, pos):
+            ctx = (
+                jax.default_matmul_precision(precision)
+                if precision
+                else _nullcontext()
+            )
+            with ctx:
+                logits, cache = forward(params, h, tokens, pos, cache)
+            last = logits[:, -1, :]
+            if greedy:
+                # On-device sampling (reference samples on host from the
+                # logits pipe; fusing argmax here avoids the [vocab] device
+                # -> host transfer per decoded token).
+                return jnp.argmax(last, axis=-1).astype(jnp.int32), cache
+            return last, cache
+
+        self._compiled[key] = step
+        return step
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    # -- public API ----------------------------------------------------------
+
+    def prefill(self, tokens: list[int], pos: int = 0) -> StepStats:
+        """Run all but the last prompt token through the cache (the last
+        token is the decode loop's first input, reference: dllama.cpp:38-68)."""
+        assert len(tokens) >= 1
+        if pos + len(tokens) - 1 > self.header.seq_len:
+            # dynamic_update_slice clamps silently; fail loudly instead
+            # (the reference bounds pos by seqLen the same way,
+            # dllama.cpp:27-28,76).
+            raise ValueError(
+                f"prompt of {len(tokens)} tokens at pos {pos} exceeds "
+                f"seqLen {self.header.seq_len}"
+            )
+        fill = tokens[:-1]
+        total_ms = 0.0
+        p = pos
+        while fill:
+            bucket = self._bucket_for(len(fill))
+            chunk = fill[:bucket]
+            fill = fill[bucket:]
+            padded = chunk + [0] * (bucket - len(chunk))
+            arr = jnp.asarray([padded] * self.batch_size, dtype=jnp.int32)
+            arr = jax.device_put(arr, self._token_sharding)
+            step = self._step_fn(bucket, greedy=False)
+            t0 = time.perf_counter()
+            # Padding tokens write garbage into cache slots [p+len(chunk),
+            # p+bucket) — harmless: the causal mask hides them until real
+            # tokens overwrite those positions.
+            _, self.cache = step(self.params, arr, self.cache, jnp.int32(p))
+            jax.block_until_ready(self.cache["k"])
+            total_ms += (time.perf_counter() - t0) * 1000
+            p += len(chunk)
+        return StepStats(time_ms=total_ms, n_tokens=max(len(tokens) - 1, 0))
+
+    def decode_step(self, token: int, pos: int) -> tuple[int, StepStats]:
+        """One decode step: feed `token` at `pos`, return the sampled next
+        token (reference: dllama.cpp:74-99)."""
+        if pos >= self.header.seq_len:
+            raise ValueError(
+                f"decode position {pos} out of range (seqLen "
+                f"{self.header.seq_len}); the KV cache would clamp silently"
+            )
+        arr = jnp.asarray([[token]] * self.batch_size, dtype=jnp.int32)
+        arr = jax.device_put(arr, self._token_sharding)
+        greedy = self.temperature == 0.0
+        step = self._step_fn(1, greedy=greedy)
+        t0 = time.perf_counter()
+        out, self.cache = step(self.params, arr, self.cache, jnp.int32(pos))
+        out = jax.block_until_ready(out)
+        ms = (time.perf_counter() - t0) * 1000
+        if greedy:
+            next_token = int(np.asarray(out)[0])
+        else:
+            next_token = self.sampler.sample(np.asarray(out)[0])
+        return next_token, StepStats(time_ms=ms, n_tokens=1)
+
+    def generate(
+        self,
+        prompt_tokens: list[int],
+        max_steps: int,
+        on_token=None,
+        stop_condition=None,
+    ):
+        """Prefill + decode loop. Yields nothing; returns (tokens, eval_stats,
+        pred_stats). `on_token(token)` fires per generated token and may
+        return False to stop (EOS handling lives with the caller, which owns
+        the tokenizer/EosDetector)."""
+        max_pos = min(self.header.seq_len, max_steps)
+        eval_stats = self.prefill(prompt_tokens)
+        pos = len(prompt_tokens) - 1
+        token = prompt_tokens[-1]
+        out_tokens: list[int] = []
+        pred_ms = 0.0
+        while pos < max_pos:
+            token, stats = self.decode_step(token, pos)
+            pred_ms += stats.time_ms
+            pos += 1
+            out_tokens.append(token)
+            if on_token is not None and on_token(token) is False:
+                break
+            if stop_condition is not None and stop_condition(token):
+                break
+        return out_tokens, eval_stats, StepStats(pred_ms, len(out_tokens))
+
+
+class _nullcontext:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

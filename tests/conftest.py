@@ -17,6 +17,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402  (after the env setup above, by design)
 
+# A pytest plugin (jaxtyping) imports jax before this conftest runs, so the
+# env vars above may be too late — force the platform via config too.
+jax.config.update("jax_platforms", "cpu")
+
 # f32 matmuls must really be f32 for oracle-equivalence tests (this JAX
 # build's default matmul precision is reduced even on CPU).
 jax.config.update("jax_default_matmul_precision", "highest")

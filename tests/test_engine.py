@@ -1,0 +1,136 @@
+"""Engine + CLI tests: generation invariants and the dllama-compatible
+command surface."""
+
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import FloatType
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.tokenizer import Tokenizer
+
+from helpers import make_tiny_model, make_tiny_tokenizer
+
+
+@pytest.fixture()
+def tiny_model(tmp_path):
+    mp = str(tmp_path / "m.m")
+    tp_ = str(tmp_path / "t.t")
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=288, seq_len=64)
+    make_tiny_model(mp, weight_type=FloatType.Q40, cfg=cfg)
+    make_tiny_tokenizer(tp_, chat_template="<|start_header_id|>")
+    return mp, tp_
+
+
+def test_generate_deterministic_greedy(tiny_model):
+    mp, tp_ = tiny_model
+    eng = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    out1, ev1, pr1 = eng.generate([1, 2, 3, 4], max_steps=12)
+    eng.reset()
+    out2, _, _ = eng.generate([1, 2, 3, 4], max_steps=12)
+    assert out1 == out2
+    assert len(out1) == 12 - 3  # maxPos - prefill positions
+    assert ev1.n_tokens == 3
+    assert pr1.n_tokens == len(out1)
+
+
+def test_generate_tp_matches_single_chip(tiny_model):
+    """The engine's sharded decode must produce the same greedy tokens as
+    single-chip — end-to-end TP equivalence including sampling."""
+    mp, _ = tiny_model
+    e1 = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    out1, _, _ = e1.generate([5, 6, 7], max_steps=10)
+    e4 = InferenceEngine(mp, tp=4, dtype=jnp.float32, temperature=0.0)
+    out4, _, _ = e4.generate([5, 6, 7], max_steps=10)
+    assert out1 == out4
+
+
+def test_generate_with_sampling_seeded(tiny_model):
+    mp, _ = tiny_model
+    e = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.9, topp=0.9, seed=7)
+    out1, _, _ = e.generate([1, 2, 3], max_steps=10)
+    e2 = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.9, topp=0.9, seed=7)
+    out2, _, _ = e2.generate([1, 2, 3], max_steps=10)
+    assert out1 == out2
+
+
+def test_prefill_bucketing_consistent(tiny_model):
+    """Bucketed/padded prefill must give the same next tokens as unbucketed."""
+    mp, _ = tiny_model
+    prompt = list(range(1, 12))  # 11 tokens -> buckets pad to 32 etc.
+    ea = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                         prefill_buckets=(4,))
+    outa, _, _ = ea.generate(prompt, max_steps=16)
+    eb = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                         prefill_buckets=(32,))
+    outb, _, _ = eb.generate(prompt, max_steps=16)
+    assert outa == outb
+
+
+def test_max_seq_len_clamps(tiny_model):
+    mp, _ = tiny_model
+    e = InferenceEngine(mp, tp=1, dtype=jnp.float32, max_seq_len=16, temperature=0.0)
+    assert e.header.seq_len == 16
+    out, _, _ = e.generate([1, 2, 3], max_steps=100)
+    assert len(out) == 16 - 2  # clamped by seq_len, not steps
+
+
+def _run_cli(args, env_extra=None):
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "dllama_tpu"] + args,
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd="/root/repo",
+        timeout=600,
+    )
+
+
+def test_cli_inference(tiny_model):
+    mp, tp_ = tiny_model
+    r = _run_cli(
+        ["inference", "--model", mp, "--tokenizer", tp_,
+         "--prompt", "hello world", "--steps", "16",
+         "--temperature", "0.0", "--dtype", "f32", "--tp", "2"]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "🔶 Pred" in r.stdout
+    assert "tokens/s:" in r.stdout
+    assert "Evaluation" in r.stdout and "Prediction" in r.stdout
+
+
+def test_cli_perplexity(tiny_model):
+    mp, tp_ = tiny_model
+    r = _run_cli(
+        ["perplexity", "--model", mp, "--tokenizer", tp_,
+         "--prompt", "hello world hello world", "--dtype", "f32"]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "perplexity:" in r.stdout
+
+
+def test_cli_worker_mode_explains(tiny_model):
+    r = _run_cli(["worker"])
+    assert r.returncode != 0
+    assert "SPMD" in r.stderr or "SPMD" in r.stdout
+
+
+def test_cli_rejects_gpu_flags(tiny_model):
+    mp, tp_ = tiny_model
+    r = _run_cli(
+        ["inference", "--model", mp, "--tokenizer", tp_, "--prompt", "x",
+         "--steps", "4", "--gpu-index", "0"]
+    )
+    assert r.returncode != 0
+    assert "TPU" in (r.stderr + r.stdout)

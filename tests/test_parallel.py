@@ -1,0 +1,133 @@
+"""Tensor-parallel equivalence: sharded forward over a 2/4/8-device mesh must
+reproduce the single-device logits (the TPU analogue of the reference's
+multi-worker-vs-single-node validation; SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dllama_tpu.formats import FloatType, ModelReader
+from dllama_tpu.formats.model_file import LlmArch
+from dllama_tpu.models import forward, init_kv_cache, load_params
+from dllama_tpu.parallel import (
+    cache_specs,
+    make_mesh,
+    param_spec_tree,
+    shard_params_put,
+    validate_tp,
+)
+
+from helpers import make_tiny_model
+
+TOKENS = [3, 17, 92, 5, 44, 120, 7, 3]
+
+
+def single_device_logits(reader, tokens):
+    params = load_params(reader)
+    h = reader.header
+    cache = init_kv_cache(h, batch_size=tokens.shape[0])
+    logits, _ = forward(params, h, tokens, jnp.int32(0), cache)
+    return np.asarray(logits)
+
+
+def sharded_logits(reader, tokens, tp, dp=1):
+    h = reader.header
+    mesh = make_mesh(tp=tp, dp=dp)
+    params = load_params(reader, put=shard_params_put(mesh, h))
+    cache = init_kv_cache(h, batch_size=tokens.shape[0])
+    cspecs = cache_specs(h)
+    cache = {
+        k: jax.device_put(v, NamedSharding(mesh, cspecs[k])) for k, v in cache.items()
+    }
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+
+    @jax.jit
+    def run(params, tokens, pos, cache):
+        return forward(params, h, tokens, pos, cache)
+
+    logits, new_cache = run(params, tokens, jnp.int32(0), cache)
+    return np.asarray(logits), new_cache, mesh
+
+
+@pytest.mark.parametrize("tp", [2, 4, 8])
+def test_tp_matches_single_device(tmp_path, tp):
+    path = str(tmp_path / "m.m")
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=16, n_kv_heads=8,
+               head_dim=16, vocab_size=256, seq_len=32)
+    make_tiny_model(path, weight_type=FloatType.F32, cfg=cfg)
+    reader = ModelReader(path)
+    validate_tp(reader.header, tp)
+    tokens = jnp.asarray([TOKENS], dtype=jnp.int32)
+    expected = single_device_logits(reader, tokens)
+    got, _, _ = sharded_logits(reader, tokens, tp=tp)
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+
+def test_tp_cache_is_sharded(tmp_path):
+    """The updated KV cache must stay sharded on the kv-head axis (no silent
+    full replication of the cache)."""
+    path = str(tmp_path / "m.m")
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=256, seq_len=32)
+    make_tiny_model(path, weight_type=FloatType.F32, cfg=cfg)
+    reader = ModelReader(path)
+    tokens = jnp.asarray([TOKENS], dtype=jnp.int32)
+    _, new_cache, mesh = sharded_logits(reader, tokens, tp=4)
+    shard = new_cache["k"].sharding
+    assert isinstance(shard, NamedSharding)
+    # kv-head axis (index 3) sharded over tp
+    assert shard.spec[3] == "tp" or (
+        shard.spec == P(None, "dp", None, "tp", None)
+    )
+
+
+def test_tp_with_dp(tmp_path):
+    """dp=2 x tp=4 over 8 devices: batch of two identical sequences."""
+    path = str(tmp_path / "m.m")
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=256, seq_len=32)
+    make_tiny_model(path, weight_type=FloatType.F32, cfg=cfg)
+    reader = ModelReader(path)
+    tokens = jnp.asarray([TOKENS, TOKENS], dtype=jnp.int32)
+    expected = single_device_logits(reader, tokens)
+    got, _, _ = sharded_logits(reader, tokens, tp=4, dp=2)
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("arch", [LlmArch.QWEN3, LlmArch.QWEN3_MOE])
+def test_tp_qwen3_variants(tmp_path, arch):
+    path = str(tmp_path / "m.m")
+    make_tiny_model(path, arch=arch, weight_type=FloatType.F32)
+    reader = ModelReader(path)
+    tokens = jnp.asarray([TOKENS], dtype=jnp.int32)
+    expected = single_device_logits(reader, tokens)
+    got, _, _ = sharded_logits(reader, tokens, tp=2)
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+
+def test_validate_tp_rejects_bad_configs(tmp_path):
+    path = str(tmp_path / "m.m")
+    make_tiny_model(path)  # n_kv_heads=2
+    h = ModelReader(path).header
+    with pytest.raises(ValueError, match="power of two"):
+        validate_tp(h, 3)
+    with pytest.raises(ValueError, match="nKvHeads"):
+        validate_tp(h, 4)
+    validate_tp(h, 2)  # ok
+
+
+def test_weight_shards_actually_split(tmp_path):
+    """Row-split weights must be distributed, not replicated: each device
+    holds 1/tp of wq (the TPU twin of splitRowMatmulWeight)."""
+    path = str(tmp_path / "m.m")
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=256, seq_len=32)
+    make_tiny_model(path, weight_type=FloatType.F32, cfg=cfg)
+    reader = ModelReader(path)
+    mesh = make_mesh(tp=4)
+    params = load_params(reader, put=shard_params_put(mesh, reader.header))
+    wq = params["layers"]["wq"]
+    shard_shapes = {s.data.shape for s in wq.addressable_shards}
+    assert shard_shapes == {(2, 64, 128 // 4)}
