@@ -86,20 +86,9 @@ def _load(args):
     tok = Tokenizer(args.tokenizer)
     tp = _resolve_tp(args)
     if tp == 0:
-        # auto: largest power of two that the device count AND the model's
-        # shardability constraints allow (mirrors the reference's
-        # nNodes <= nKvHeads rule, src/app.cpp:236-238)
-        from .formats import read_llm_header
-        from .parallel import validate_tp
+        from .parallel.mesh import auto_tp
 
-        h0 = read_llm_header(args.model)
-        tp = 1
-        while tp * 2 <= len(jax.devices()):
-            try:
-                validate_tp(h0, tp * 2)
-            except ValueError:
-                break
-            tp *= 2
+        tp = auto_tp(args.model)
     engine = InferenceEngine(
         args.model,
         tokenizer=tok,

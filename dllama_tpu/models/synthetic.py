@@ -114,7 +114,9 @@ def random_params(
                 lambda: jnp.ones(shape, jnp.float32), out_shardings=sh
             )
             return f()
-        key = jax.random.fold_in(root_key, abs(hash(name)) % (2**31))
+        import zlib
+
+        key = jax.random.fold_in(root_key, zlib.crc32(name.encode()))
         f = jax.jit(
             lambda k: jax.random.normal(k, shape, dtype) * jnp.asarray(scale, dtype),
             out_shardings=sh,

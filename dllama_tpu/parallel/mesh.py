@@ -45,6 +45,26 @@ def validate_tp(h: LlmHeader, tp: int) -> None:
             raise ValueError(f"{name}={dim} not divisible by tp={tp}")
 
 
+def auto_tp(model_path: str, n_devices: int | None = None) -> int:
+    """Largest power-of-two tp that both the device count and the model's
+    shardability constraints allow (mirrors the reference's
+    nNodes <= nKvHeads rule, src/app.cpp:236-238). Shared by the CLI and
+    the API server."""
+    from ..formats.model_file import read_llm_header
+
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    h = read_llm_header(model_path)
+    tp = 1
+    while tp * 2 <= n_devices:
+        try:
+            validate_tp(h, tp * 2)
+        except ValueError:
+            break
+        tp *= 2
+    return tp
+
+
 def make_mesh(tp: int = 1, dp: int = 1, devices=None) -> Mesh:
     """Build a (dp, tp) mesh over the available devices.
 

@@ -1,0 +1,437 @@
+"""OpenAI-compatible HTTP API server.
+
+Capability port of the reference's `dllama-api` (src/dllama-api.cpp):
+
+* ``POST /v1/chat/completions`` — chat completion with ``stream`` (SSE),
+  ``temperature``, ``seed``, ``max_tokens``, ``stop`` parameters
+  (src/dllama-api.cpp:491-520);
+* ``GET /v1/models`` — single-model listing (src/dllama-api.cpp:538-547);
+* **NaiveCache** — KV positions are reused when a new request's messages
+  are a strict superset of the previous conversation
+  (src/dllama-api.cpp:298-343).
+
+The reference hand-rolls an HTTP/1.1 server over raw sockets; here Python's
+stdlib ThreadingHTTPServer carries the protocol and a lock serializes model
+access (the reference's accept loop is single-threaded, same effective
+policy — one generation at a time, but connections don't get refused).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..tokenizer import (
+    ChatItem,
+    ChatTemplateGenerator,
+    ChatTemplateType,
+    EosDetector,
+    EosResult,
+    Tokenizer,
+)
+from .engine import InferenceEngine
+
+
+@dataclass
+class ChatMessage:
+    role: str
+    content: str
+
+
+@dataclass
+class NaiveCacheItem:
+    end_pos: int
+    message: ChatMessage
+
+
+class NaiveCache:
+    """Prompt-prefix KV reuse (reference: src/dllama-api.cpp:298-343)."""
+
+    def __init__(self):
+        self.items: list[NaiveCacheItem] = []
+
+    def push(self, item: NaiveCacheItem) -> None:
+        self.items.append(item)
+
+    def clear(self) -> None:
+        self.items = []
+
+    def resolve_delta_prompt(
+        self, messages: list[ChatMessage]
+    ) -> tuple[list[ChatMessage], int]:
+        """If `messages` extends the cached conversation, return only the new
+        suffix plus the cache's end position; else reset."""
+        n = len(self.items)
+        if n == 0:
+            return messages, 0
+        if len(messages) > n:
+            i = 0
+            while i < n:
+                if (
+                    self.items[i].message.role != messages[i].role
+                    or self.items[i].message.content != messages[i].content
+                ):
+                    break
+                i += 1
+            if i == n:
+                start_pos = self.items[i - 1].end_pos
+                print(f"🐤 Found naive cache for {i} messages, pos={start_pos}")
+                return messages[i:], start_pos
+        self.clear()
+        return messages, 0
+
+
+@dataclass
+class InferenceParams:
+    messages: list[ChatMessage] = field(default_factory=list)
+    temperature: float = 0.8
+    top_p: float = 0.9
+    seed: int | None = None
+    stream: bool = False
+    max_tokens: int = -1
+    stop: list[str] = field(default_factory=list)
+
+
+class ApiState:
+    """Engine + tokenizer + conversation cache shared across requests."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        tokenizer: Tokenizer,
+        model_name: str = "dllama-tpu",
+        chat_template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
+    ):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        stops = [
+            tokenizer.vocab[t].decode("utf-8", "replace")
+            for t in tokenizer.eos_token_ids
+        ]
+        eos_piece = stops[0] if stops else ""
+        self.stops = stops
+        self.max_stop_len = max((len(s) for s in stops), default=0)
+        self.template = ChatTemplateGenerator(
+            chat_template_type, tokenizer.chat_template, eos_piece
+        )
+        self.naive_cache = NaiveCache()
+        self.lock = threading.Lock()
+
+    # -- completion ------------------------------------------------------
+
+    def complete(self, params: InferenceParams, emit) -> dict:
+        """Run one chat completion; `emit(delta)` is called per text delta
+        (streaming). Returns the non-stream response dict.
+        (reference: ApiServer::complete, src/dllama-api.cpp:367-487)"""
+        engine, tok = self.engine, self.tokenizer
+        engine.temperature = params.temperature
+        engine.sampler.set_temp(params.temperature)
+        if params.seed is not None:
+            engine.sampler.set_seed(params.seed)
+
+        delta_prompt, start_pos = self.naive_cache.resolve_delta_prompt(
+            params.messages
+        )
+        if start_pos == 0:
+            engine.reset()
+
+        items = [ChatItem(m.role, m.content) for m in delta_prompt]
+        prompt = self.template.generate(items, append_generation_prompt=True)
+        tokens = tok.encode(
+            prompt.content, is_start=start_pos == 0, add_special_tokens=True
+        )
+        n_prompt_tokens = len(tokens)
+        seq_len = engine.header.seq_len
+        prompt_end_pos = min(start_pos + n_prompt_tokens - 1, seq_len)
+        max_pred_pos = (
+            min(prompt_end_pos + params.max_tokens, seq_len)
+            if params.max_tokens > 0
+            else seq_len
+        )
+
+        buffer = ""
+        if prompt.public_prompt:
+            emit(prompt.public_prompt)
+            buffer += prompt.public_prompt
+
+        engine.prefill(tokens, pos=start_pos)
+        pos = prompt_end_pos
+        token = tokens[-1]
+        tok.reset_decoder()
+        detector = EosDetector(
+            tok.eos_token_ids,
+            self.stops if not params.stop else params.stop,
+            padding_left=self.max_stop_len,
+            padding_right=self.max_stop_len,
+        )
+
+        while pos < max_pred_pos:
+            token, _ = engine.decode_step(token, pos)
+            piece = tok.decode(token)
+            eos_type = detector.append(token, piece)
+            if eos_type in (EosResult.NOT_EOS, EosResult.EOS):
+                delta = detector.get_delta()
+                if delta:
+                    emit(delta)
+                    buffer += delta
+                detector.reset()
+            pos += 1
+            if eos_type == EosResult.EOS:
+                break
+
+        message = ChatMessage("assistant", buffer)
+        if pos >= seq_len:
+            self.naive_cache.clear()
+            engine.reset()
+        else:
+            # Record the conversation only now that its KV entries really
+            # exist (pushing before prefill would let a failed request
+            # poison the cache with positions that were never written).
+            for m in delta_prompt:
+                self.naive_cache.push(NaiveCacheItem(prompt_end_pos, m))
+            self.naive_cache.push(NaiveCacheItem(pos, message))
+
+        n_completion = pos - prompt_end_pos
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": buffer},
+                    "finish_reason": "stop",
+                }
+            ],
+            "usage": {
+                "prompt_tokens": n_prompt_tokens,
+                "completion_tokens": n_completion,
+                "total_tokens": n_prompt_tokens + n_completion,
+            },
+        }
+
+
+def _chunk_payload(state: ApiState, delta: str | None, stop: bool) -> dict:
+    choice: dict = {"index": 0, "finish_reason": "stop" if stop else ""}
+    if not stop:
+        choice["delta"] = {"role": "assistant", "content": delta}
+    return {
+        "id": "cmpl-1",
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": state.model_name,
+        "choices": [choice],
+    }
+
+
+def make_handler(state: ApiState):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet access log
+            pass
+
+        def _json(self, payload: dict, status: int = 200) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header("Content-Type", "application/json; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_OPTIONS(self):  # CORS preflight (reference: writeCors)
+            self.send_response(204)
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header(
+                "Access-Control-Allow-Methods", "GET, POST, PUT, DELETE"
+            )
+            self.send_header(
+                "Access-Control-Allow-Headers", "Content-Type, Authorization"
+            )
+            self.end_headers()
+
+        def do_GET(self):
+            if self.path == "/v1/models":
+                self._json(
+                    {
+                        "object": "list",
+                        "data": [
+                            {
+                                "id": state.model_name,
+                                "object": "model",
+                                "created": 0,
+                                "owned_by": "user",
+                            }
+                        ],
+                    }
+                )
+            elif self.path in ("/health", "/healthz"):
+                self._json({"status": "ok"})
+            else:
+                self.send_error(404, "Not Found")
+
+        def do_POST(self):
+            if self.path != "/v1/chat/completions":
+                self.send_error(404, "Not Found")
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                params = self._parse_params(body)
+            except (ValueError, KeyError, TypeError) as e:
+                self._json({"error": {"message": f"bad request: {e}"}}, 400)
+                return
+
+            with state.lock:
+                if params.stream:
+                    self._stream(params)
+                else:
+                    try:
+                        response = state.complete(params, emit=lambda d: None)
+                    except ValueError as e:  # client-caused (e.g. prompt too long)
+                        self._json({"error": {"message": str(e)}}, 400)
+                        return
+                    except Exception as e:  # surface model errors as JSON
+                        self._json({"error": {"message": str(e)}}, 500)
+                        return
+                    self._json(response)
+
+        def _stream(self, params: InferenceParams) -> None:
+            self.send_response(200)
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header("Content-Type", "text/event-stream; charset=utf-8")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_chunk(data: str) -> None:
+                raw = data.encode("utf-8")
+                self.wfile.write(f"{len(raw):x}\r\n".encode() + raw + b"\r\n")
+
+            def emit(delta: str) -> None:
+                payload = _chunk_payload(state, delta, stop=False)
+                write_chunk(f"data: {json.dumps(payload)}\r\n\r\n")
+
+            try:
+                state.complete(params, emit=emit)
+            except Exception as e:
+                # headers are already sent; deliver the error in-stream so
+                # the client still gets a well-formed SSE termination
+                write_chunk(
+                    f"data: {json.dumps({'error': {'message': str(e)}})}\r\n\r\n"
+                )
+            write_chunk(
+                f"data: {json.dumps(_chunk_payload(state, None, stop=True))}\r\n\r\n"
+            )
+            write_chunk("data: [DONE]")
+            self.wfile.write(b"0\r\n\r\n")
+
+        def _parse_params(self, body: dict) -> InferenceParams:
+            """(reference: parseRequest, src/dllama-api.cpp:491-520)"""
+            params = InferenceParams(
+                temperature=state.engine.temperature,
+                top_p=state.engine.sampler.topp,
+                stop=[],
+            )
+            params.messages = [
+                ChatMessage(m["role"], m["content"]) for m in body["messages"]
+            ]
+            if "stream" in body:
+                params.stream = bool(body["stream"])
+            if "temperature" in body:
+                params.temperature = float(body["temperature"])
+            if "seed" in body:
+                params.seed = int(body["seed"])
+            if "max_tokens" in body:
+                params.max_tokens = int(body["max_tokens"])
+            if "stop" in body:
+                stop = body["stop"]
+                # OpenAI allows a bare string or a list of strings
+                params.stop = [stop] if isinstance(stop, str) else [str(x) for x in stop]
+            return params
+
+    return Handler
+
+
+def serve(
+    engine: InferenceEngine,
+    tokenizer: Tokenizer,
+    host: str = "0.0.0.0",
+    port: int = 9990,
+    model_name: str = "dllama-tpu",
+    chat_template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
+):
+    state = ApiState(engine, tokenizer, model_name, chat_template_type)
+    server = ThreadingHTTPServer((host, port), make_handler(state))
+    if host in ("0.0.0.0", "127.0.0.1"):
+        print(f"Server URL: http://localhost:{port}/v1/")
+    return server  # caller runs serve_forever() (tests drive it in a thread)
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..cli import _resolve_tp
+
+    parser = argparse.ArgumentParser(prog="dllama-tpu-api")
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--tokenizer", required=True)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9990)
+    parser.add_argument("--temperature", type=float, default=0.8)
+    parser.add_argument("--topp", type=float, default=0.9)
+    parser.add_argument("--seed", type=int, default=int(time.time()))
+    parser.add_argument("--max-seq-len", type=int, default=0)
+    parser.add_argument("--tp", type=int, default=0)
+    parser.add_argument("--workers", nargs="*", default=None)
+    parser.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+    parser.add_argument("--nthreads", type=int, default=1)
+    parser.add_argument("--buffer-float-type", default="q80")
+    parser.add_argument("--gpu-index", type=int, default=None)
+    parser.add_argument("--gpu-segments", default=None)
+    args = parser.parse_args(argv)
+
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    tok = Tokenizer(args.tokenizer)
+    tp = _resolve_tp(args)
+    if tp == 0:
+        from ..parallel.mesh import auto_tp
+
+        tp = auto_tp(args.model)
+    engine = InferenceEngine(
+        args.model,
+        tokenizer=tok,
+        tp=tp,
+        dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
+        max_seq_len=args.max_seq_len,
+        temperature=args.temperature,
+        topp=args.topp,
+        seed=args.seed,
+    )
+    import os.path
+
+    server = serve(
+        engine,
+        tok,
+        host=args.host,
+        port=args.port,
+        model_name=os.path.basename(args.model),
+    )
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

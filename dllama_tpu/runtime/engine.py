@@ -12,6 +12,7 @@ temperature/top-p path uses the reference-parity host sampler.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from functools import partial
@@ -112,7 +113,7 @@ class InferenceEngine:
             ctx = (
                 jax.default_matmul_precision(precision)
                 if precision
-                else _nullcontext()
+                else contextlib.nullcontext()
             )
             with ctx:
                 logits, cache = forward(params, h, tokens, pos, cache)
@@ -127,11 +128,20 @@ class InferenceEngine:
         self._compiled[key] = step
         return step
 
-    def _bucket_for(self, n: int) -> int:
-        for b in self.prefill_buckets:
+    def _bucket_for(self, n: int, pos: int) -> int:
+        """Smallest bucket covering n tokens whose PADDED extent still fits
+        in the cache (dynamic_update_slice clamps silently if pos+bucket >
+        seqLen, which would corrupt earlier cache rows)."""
+        space = self.header.seq_len - pos
+        fitting = [b for b in self.prefill_buckets if b <= space]
+        if not fitting:
+            # guarded by the prefill bounds check: space >= 1 and bucket 1
+            # may not be configured; fall back to exact width
+            return space
+        for b in fitting:
             if n <= b:
                 return b
-        return self.prefill_buckets[-1]
+        return fitting[-1]
 
     # -- public API ----------------------------------------------------------
 
@@ -151,7 +161,7 @@ class InferenceEngine:
         total_ms = 0.0
         p = pos
         while fill:
-            bucket = self._bucket_for(len(fill))
+            bucket = self._bucket_for(len(fill), p)
             chunk = fill[:bucket]
             fill = fill[bucket:]
             padded = chunk + [0] * (bucket - len(chunk))
@@ -218,10 +228,3 @@ class InferenceEngine:
                 break
         return out_tokens, eval_stats, StepStats(pred_ms, len(out_tokens))
 
-
-class _nullcontext:
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        return False

@@ -160,7 +160,10 @@ class EosDetector:
         padding_left: int = 0,
         padding_right: int = 0,
     ):
-        assert len(tokens) == len(pieces)
+        # Unlike the reference (which always passes parallel arrays), the
+        # token-id set and the stop-string set are independent here: the API
+        # server combines the tokenizer's EOS ids with client-supplied stop
+        # strings of any count.
         self.tokens = list(tokens)
         self.pieces = list(pieces)
         self.piece_sizes = [len(p) for p in pieces]

@@ -1,0 +1,195 @@
+"""API server tests: OpenAI-compatible surface over a tiny model."""
+
+import json
+import threading
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from dllama_tpu.formats import FloatType
+from dllama_tpu.runtime.api_server import ApiState, NaiveCache, ChatMessage, serve
+from dllama_tpu.runtime.engine import InferenceEngine
+from dllama_tpu.tokenizer import Tokenizer
+
+from helpers import make_tiny_model, make_tiny_tokenizer
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    d = tmp_path_factory.mktemp("api")
+    mp, tp_ = str(d / "m.m"), str(d / "t.t")
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=288, seq_len=384)
+    make_tiny_model(mp, weight_type=FloatType.Q40, cfg=cfg)
+    make_tiny_tokenizer(tp_, chat_template="<|start_header_id|>")
+    tok = Tokenizer(tp_)
+    engine = InferenceEngine(
+        mp, tokenizer=tok, tp=1, dtype=jnp.float32, temperature=0.0, seed=3
+    )
+    srv = serve(engine, tok, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url + "/v1/chat/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    return urllib.request.urlopen(req, timeout=300)
+
+
+def test_models_endpoint(server):
+    with urllib.request.urlopen(server + "/v1/models") as r:
+        data = json.loads(r.read())
+    assert data["object"] == "list"
+    assert data["data"][0]["object"] == "model"
+
+
+def test_chat_completion(server):
+    with _post(
+        server,
+        {
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 8,
+            "temperature": 0,
+        },
+    ) as r:
+        data = json.loads(r.read())
+    assert data["object"] == "chat.completion"
+    choice = data["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert choice["finish_reason"] == "stop"
+    usage = data["usage"]
+    assert usage["prompt_tokens"] > 0
+    assert usage["total_tokens"] == usage["prompt_tokens"] + usage["completion_tokens"]
+    assert usage["completion_tokens"] <= 8
+
+
+def test_chat_completion_streaming(server):
+    with _post(
+        server,
+        {
+            "messages": [{"role": "user", "content": "hello world"}],
+            "max_tokens": 6,
+            "temperature": 0,
+            "stream": True,
+        },
+    ) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = r.read().decode()
+    events = [
+        json.loads(line[len("data: "):])
+        for line in raw.splitlines()
+        if line.startswith("data: ") and line != "data: [DONE]"
+    ]
+    assert raw.rstrip().endswith("data: [DONE]")
+    assert events, "no SSE chunks"
+    assert events[-1]["choices"][0]["finish_reason"] == "stop"
+    for e in events[:-1]:
+        assert e["object"] == "chat.completion.chunk"
+        assert e["choices"][0]["delta"]["role"] == "assistant"
+
+
+def test_naive_cache_reuses_prefix(server):
+    msgs = [{"role": "user", "content": "first question"}]
+    with _post(server, {"messages": msgs, "max_tokens": 4, "temperature": 0}) as r:
+        first = json.loads(r.read())
+    reply = first["choices"][0]["message"]["content"]
+    msgs2 = msgs + [
+        {"role": "assistant", "content": reply},
+        {"role": "user", "content": "second question"},
+    ]
+    with _post(server, {"messages": msgs2, "max_tokens": 4, "temperature": 0}) as r:
+        second = json.loads(r.read())
+    # prefix reuse: second request's reported prompt only covers the delta
+    assert second["usage"]["prompt_tokens"] < len(json.dumps(msgs2))
+    assert second["choices"][0]["message"]["role"] == "assistant"
+
+
+def test_seed_param_deterministic(server):
+    payload = {
+        "messages": [{"role": "user", "content": "tell me"}],
+        "max_tokens": 6,
+        "temperature": 0.9,
+        "seed": 42,
+    }
+    with _post(server, payload) as r:
+        a = json.loads(r.read())["choices"][0]["message"]["content"]
+    with _post(server, payload) as r:
+        b = json.loads(r.read())["choices"][0]["message"]["content"]
+    assert a == b
+
+
+def test_not_found(server):
+    try:
+        urllib.request.urlopen(server + "/nope", timeout=30)
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_bad_request(server):
+    try:
+        _post(server, {"no_messages": True})
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_naive_cache_unit():
+    c = NaiveCache()
+    m1 = ChatMessage("user", "a")
+    c.push(type("I", (), {"end_pos": 5, "message": m1})())
+    msgs, pos = c.resolve_delta_prompt([m1, ChatMessage("user", "b")])
+    assert pos == 5
+    assert len(msgs) == 1 and msgs[0].content == "b"
+    # mismatch clears
+    msgs, pos = c.resolve_delta_prompt([ChatMessage("user", "x"), ChatMessage("user", "y")])
+    assert pos == 0 and len(msgs) == 2
+    assert c.items == []
+
+
+def test_stop_as_string_and_mismatched_count(server):
+    # OpenAI allows `stop` as a bare string; also more stops than eos ids
+    with _post(
+        server,
+        {
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4,
+            "temperature": 0,
+            "stop": "###",
+        },
+    ) as r:
+        assert json.loads(r.read())["object"] == "chat.completion"
+    with _post(
+        server,
+        {
+            "messages": [{"role": "user", "content": "hi again"}],
+            "max_tokens": 4,
+            "temperature": 0,
+            "stop": ["###", "END", "@@@"],
+        },
+    ) as r:
+        assert json.loads(r.read())["object"] == "chat.completion"
+
+
+def test_stream_error_still_terminates(server):
+    # a prompt that overflows seq_len raises inside complete(); the SSE
+    # stream must still deliver an error payload and [DONE]
+    big = "x" * 4000
+    with _post(
+        server,
+        {
+            "messages": [{"role": "user", "content": big}],
+            "stream": True,
+        },
+    ) as r:
+        raw = r.read().decode()
+    assert '"error"' in raw
+    assert raw.rstrip().endswith("data: [DONE]")

@@ -134,3 +134,19 @@ def test_cli_rejects_gpu_flags(tiny_model):
     )
     assert r.returncode != 0
     assert "TPU" in (r.stderr + r.stdout)
+
+
+def test_prefill_bucket_never_pads_past_seq_len(tiny_model):
+    """Padded chunk extent must respect seqLen (dynamic_update_slice clamps
+    silently otherwise, corrupting earlier cache rows)."""
+    mp, _ = tiny_model
+    # seq_len=64; prompt of 44 with buckets (8, 32): last chunks must not
+    # write a padded 32-wide window past position 64
+    e = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                        max_seq_len=48, prefill_buckets=(8, 32))
+    prompt = list(range(1, 45))  # 44 tokens
+    out_bucketed, _, _ = e.generate(prompt, max_steps=47)
+    e2 = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                         max_seq_len=48, prefill_buckets=(8,))
+    out_exact, _, _ = e2.generate(prompt, max_steps=47)
+    assert out_bucketed == out_exact
