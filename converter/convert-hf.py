@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Convert a HuggingFace safetensors checkpoint to a distributed-llama `.m` file.
+
+Same CLI and output as the reference converter (converter/convert-hf.py):
+
+    python convert-hf.py <sourceFolderPath> <weightsFloatType> <name>
+
+Supported architectures: llama / mistral (LLAMA), qwen3, qwen3_moe.
+Tensor order and quantization are byte-compatible with the reference (the
+reader in dllama_tpu.formats consumes either converter's output).
+
+Fresh implementation on numpy + safetensors (no torch dependency): tensors
+stream one at a time, so host memory stays at one tensor regardless of
+checkpoint size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dllama_tpu.formats.quants import FloatType, parse_float_type  # noqa: E402
+from dllama_tpu.formats.writer import write_header, write_tensor  # noqa: E402
+
+ARCH_TYPES = {
+    "llama": 0xABCD00,
+    "mistral": 0xABCD00,
+    "qwen3": 0xABCD01,
+    "qwen3_moe": 0xABCD02,
+}
+HIDDEN_ACTS = {"gelu": 0, "silu": 1}
+
+
+def permute_rows(tensor: np.ndarray, n_heads: int) -> np.ndarray:
+    """Re-order q/k projection rows from HF half-rotation layout to the
+    interleaved-rope layout (reference: convert-hf.py:13-16)."""
+    out_dim = tensor.shape[0]
+    return (
+        tensor.reshape(n_heads, 2, out_dim // n_heads // 2, *tensor.shape[1:])
+        .swapaxes(1, 2)
+        .reshape(tensor.shape)
+    )
+
+
+def parse_rms_norm_epsilon(eps: float) -> int:
+    if eps == 1e-5:
+        return 5
+    if eps == 1e-6:
+        return 6
+    raise ValueError(f"unsupported epsilon: {eps}")
+
+
+def load_config(folder: str, weights_float_type: int) -> dict:
+    with open(os.path.join(folder, "config.json")) as f:
+        config = json.load(f)
+    arch = ARCH_TYPES.get(config["model_type"])
+    if arch is None:
+        raise ValueError(f"unsupported arch type: {config['model_type']}")
+    result = {
+        "version": 0,
+        "arch_type": arch,
+        "hidden_act": HIDDEN_ACTS[config["hidden_act"]],
+        "dim": config["hidden_size"],
+        "hidden_dim": config["intermediate_size"],
+        "n_layers": config["num_hidden_layers"],
+        "n_heads": config["num_attention_heads"],
+        "n_kv_heads": config["num_key_value_heads"],
+        "weights_float_type": weights_float_type,
+        "max_seq_len": config["max_position_embeddings"],
+        "vocab_size": config["vocab_size"],
+    }
+    result["n_experts"] = int(config.get("num_experts") or 0)
+    result["n_active_experts"] = int(config.get("num_experts_per_tok") or 0)
+    if config.get("rope_theta") is not None:
+        result["rope_theta"] = int(config["rope_theta"])
+    scaling = config.get("rope_scaling")
+    if scaling is not None:
+        if scaling.get("rope_type") != "llama3":
+            raise ValueError(f"unsupported rope type: {scaling.get('rope_type')}")
+        result["rope_scaling_factor"] = int(scaling["factor"])
+        result["rope_scaling_low_freq_factor"] = int(scaling["low_freq_factor"])
+        result["rope_scaling_high_freq_factory"] = int(scaling["high_freq_factor"])
+        result["rope_scaling_orig_max_seq_len"] = int(
+            scaling["original_max_position_embeddings"]
+        )
+        result["rope_type"] = 2  # LLAMA3_1
+    if config.get("head_dim") is not None:
+        result["head_dim"] = config["head_dim"]
+    if config.get("rms_norm_eps") is not None:
+        result["norm_epsilon"] = parse_rms_norm_epsilon(config["rms_norm_eps"])
+    if config.get("moe_intermediate_size") is not None:
+        result["moe_hidden_dim"] = int(config["moe_intermediate_size"])
+    return result
+
+
+class SafetensorsIndex:
+    """name -> (file, lazy tensor) across all shards, loaded one file at a
+    time in name-lookup order (the reference walks files the same way)."""
+
+    def __init__(self, folder: str):
+        from safetensors import safe_open
+
+        self.files = sorted(
+            os.path.join(folder, f)
+            for f in os.listdir(folder)
+            if f.endswith(".safetensors") and not f.startswith(".")
+        )
+        if not self.files:
+            raise FileNotFoundError("no .safetensors files found")
+        self.location: dict[str, str] = {}
+        for path in self.files:
+            with safe_open(path, framework="np") as f:
+                for key in f.keys():
+                    self.location[key] = path
+        self._open_path: str | None = None
+        self._open = None
+
+    def get(self, *names: str) -> tuple[str, np.ndarray]:
+        from safetensors import safe_open
+
+        for name in names:
+            path = self.location.get(name)
+            if path is None:
+                continue
+            if path != self._open_path:
+                self._open = safe_open(path, framework="np")
+                self._open_path = path
+            return name, self._open.get_tensor(name)
+        raise KeyError(f"tensor not found: {names[0]}")
+
+
+def tensor_plan(config: dict, wt: int) -> list[tuple]:
+    """(float_type, transform?, *lookup_names) in file order
+    (reference: convert-hf.py:59-104)."""
+    arch = config["arch_type"]
+    n_heads = config["n_heads"]
+    plan: list[tuple] = [(FloatType.F32, None, "model.embed_tokens.weight")]
+    is_llama = arch == ARCH_TYPES["llama"]
+    q_perm = (lambda t: permute_rows(t, n_heads)) if is_llama else None
+    k_perm = (
+        (lambda t: permute_rows(t, config["n_kv_heads"])) if is_llama else None
+    )
+    for l in range(config["n_layers"]):
+        plan.append((wt, q_perm, f"model.layers.{l}.self_attn.q_proj.weight"))
+        plan.append((wt, k_perm, f"model.layers.{l}.self_attn.k_proj.weight"))
+        plan.append((wt, None, f"model.layers.{l}.self_attn.v_proj.weight"))
+        plan.append((wt, None, f"model.layers.{l}.self_attn.o_proj.weight"))
+        if config["n_experts"] > 0:
+            plan.append((FloatType.F32, None, f"model.layers.{l}.mlp.gate.weight"))
+            for e in range(config["n_experts"]):
+                plan.append((wt, None, f"model.layers.{l}.mlp.experts.{e}.gate_proj.weight"))
+                plan.append((wt, None, f"model.layers.{l}.mlp.experts.{e}.down_proj.weight"))
+                plan.append((wt, None, f"model.layers.{l}.mlp.experts.{e}.up_proj.weight"))
+        else:
+            plan.append((wt, None, f"model.layers.{l}.mlp.gate_proj.weight"))
+            plan.append((wt, None, f"model.layers.{l}.mlp.down_proj.weight"))
+            plan.append((wt, None, f"model.layers.{l}.mlp.up_proj.weight"))
+        if arch in (ARCH_TYPES["qwen3"], ARCH_TYPES["qwen3_moe"]):
+            plan.append((FloatType.F32, None, f"model.layers.{l}.self_attn.q_norm.weight"))
+            plan.append((FloatType.F32, None, f"model.layers.{l}.self_attn.k_norm.weight"))
+        plan.append((FloatType.F32, None, f"model.layers.{l}.input_layernorm.weight"))
+        plan.append((FloatType.F32, None, f"model.layers.{l}.post_attention_layernorm.weight"))
+    plan.append((FloatType.F32, None, "model.norm.weight"))
+    # lm_head falls back to tied embeddings (reference: convert-hf.py:103-104)
+    plan.append((wt, None, "lm_head.weight", "model.embed_tokens.weight"))
+    return plan
+
+
+def convert(folder: str, weights_float_type: FloatType, output_path: str) -> None:
+    config = load_config(folder, int(weights_float_type))
+    index = SafetensorsIndex(folder)
+    with open(output_path, "wb") as out:
+        write_header(out, config)
+        for item in tensor_plan(config, int(weights_float_type)):
+            ft, transform, *lookup = item
+            name, tensor = index.get(*lookup)
+            tensor = np.asarray(tensor, dtype=np.float32)
+            print(f"🔶 Writing tensor {name} {tensor.shape}...")
+            if transform is not None:
+                tensor = transform(tensor)
+            write_tensor(out, tensor, FloatType(ft))
+
+
+def print_usage():
+    print("Usage: python convert-hf.py <sourceFolderPath> <weightsFloatType> <name>")
+    print()
+    print("Options:")
+    print("  <sourceFolderPath> The path to the folder containing the model files")
+    print('  <weightsFloatType> The float type of the weights (e.g. "q40")')
+    print('  <name>             The name of the model (e.g. "llama3")')
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 4:
+        print_usage()
+        sys.exit(1)
+    folder = sys.argv[1]
+    weights_float_type = parse_float_type(sys.argv[2])
+    name = sys.argv[3]
+    output = f"dllama_model_{name}_{sys.argv[2]}.m"
+    print(f"Output file: {output}")
+    convert(folder, weights_float_type, output)
+    print(f"✅ {output} created successfully")

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Convert original Meta Llama `.pth` checkpoints (consolidated.*.pth) to `.m`.
+
+Same CLI and output as the reference (converter/convert-llama.py):
+
+    python convert-llama.py <modelPath> <targetFloatType>
+
+Slices are concatenated across consolidated files on the original
+megatron-style split axes: axis 1 for tok_embeddings/wo/w2, axis 0 for the
+row-parallel projections. Needs torch (CPU) to read the pickle files.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dllama_tpu.formats.quants import FloatType, float_type_name, parse_float_type  # noqa: E402
+from dllama_tpu.formats.writer import write_header, write_tensor  # noqa: E402
+
+LAYER_CHUNK_SIZE = 48
+
+
+def layer_names(n_layers: int) -> list[str]:
+    names = ["tok_embeddings.weight"]
+    for l in range(n_layers):
+        names += [
+            f"layers.{l}.attention.wq.weight",
+            f"layers.{l}.attention.wk.weight",
+            f"layers.{l}.attention.wv.weight",
+            f"layers.{l}.attention.wo.weight",
+            f"layers.{l}.feed_forward.w1.weight",
+            f"layers.{l}.feed_forward.w2.weight",
+            f"layers.{l}.feed_forward.w3.weight",
+            f"layers.{l}.attention_norm.weight",
+            f"layers.{l}.ffn_norm.weight",
+        ]
+    names += ["norm.weight", "output.weight"]
+    return names
+
+
+def convert(model_path: str, output_path: str, target: FloatType) -> None:
+    import torch
+
+    with open(os.path.join(model_path, "params.json")) as f:
+        params = json.load(f)
+    if params["vocab_size"] < 1:
+        raise SystemExit("vocab_size is invalid, please update params.json file")
+    if params.get("max_seq_len") is None:
+        raise SystemExit("max_seq_len is required, please update params.json file")
+
+    header = {
+        "version": 0,
+        "arch_type": 0xABCD00,
+        "dim": params["dim"],
+        "n_layers": params["n_layers"],
+        "n_heads": params["n_heads"],
+        "n_kv_heads": params.get("n_kv_heads") or params["n_heads"],
+        "n_experts": 0,
+        "n_active_experts": 0,
+        "vocab_size": params["vocab_size"],
+        "max_seq_len": params["max_seq_len"],
+        "weights_float_type": int(target),
+    }
+    if "rope_theta" in params:
+        header["rope_theta"] = int(params["rope_theta"])
+
+    model_paths = sorted(Path(model_path).glob("consolidated.*.pth"))
+    n_slices = len(model_paths)
+    if n_slices == 0:
+        raise SystemExit("no consolidated.*.pth files found")
+
+    names = layer_names(params["n_layers"])
+    header_written = False
+
+    with open(output_path, "wb") as out:
+        n_chunks = math.ceil(len(names) / LAYER_CHUNK_SIZE)
+        for chunk_index in range(n_chunks):
+            chunk = names[LAYER_CHUNK_SIZE * chunk_index : LAYER_CHUNK_SIZE * (chunk_index + 1)]
+            collected: dict[str, list] = {n: [] for n in chunk}
+            print(f"💿 Chunking model {chunk_index + 1}/{n_chunks}...")
+            for path in model_paths:
+                model = torch.load(path, map_location="cpu", weights_only=True)
+                for key in model:
+                    if key in collected:
+                        collected[key].append(model[key])
+                if not header_written:
+                    header["hidden_dim"] = (
+                        model["layers.0.feed_forward.w1.weight"].shape[0] * n_slices
+                    )
+                    write_header(out, header)
+                    header_written = True
+                del model
+
+            for name in chunk:
+                if name == "rope.freqs":
+                    continue
+                is_axis1 = (
+                    name == "tok_embeddings.weight"
+                    or name.endswith(".attention.wo.weight")
+                    or name.endswith(".feed_forward.w2.weight")
+                )
+                is_always_f32 = (
+                    name == "tok_embeddings.weight"
+                    or name.endswith(".attention_norm.weight")
+                    or name.endswith(".ffn_norm.weight")
+                    or name == "norm.weight"
+                )
+                ft = FloatType.F32 if is_always_f32 else target
+                tensors = collected[name]
+                if len(tensors) == 1 or tensors[0].dim() == 1:
+                    merged = tensors[0]
+                else:
+                    merged = torch.cat(tensors, dim=1 if is_axis1 else 0)
+                print(f"🔶 Exporting {name} {tuple(merged.shape)}...")
+                write_tensor(
+                    out, merged.to(torch.float32).numpy().astype(np.float32), ft
+                )
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 3:
+        print("Usage: python convert-llama.py <modelPath> <targetFloatType>")
+        sys.exit(1)
+    model_path = sys.argv[1]
+    target = parse_float_type(sys.argv[2])
+    model_name = os.path.basename(model_path)
+    output = f"dllama_model_{model_name.lower()}_{float_type_name(target)}.m"
+    print(f"Model name: {model_name}")
+    print(f"Target float type: {float_type_name(target)}")
+    print(f"Target file: {output}")
+    convert(model_path, output, target)
+    print("Done!")

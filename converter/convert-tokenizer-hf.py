@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Convert a HuggingFace tokenizer folder to a distributed-llama `.t` file.
+
+Same CLI and output as the reference (converter/convert-tokenizer-hf.py):
+
+    python convert-tokenizer-hf.py <tokenizerFolderPath> <name>
+
+Handles fast tokenizers (tokenizer.json; GPT-2 byte-to-unicode inversion,
+scores = -token_id so lower ids merge first) and sentencepiece
+LlamaTokenizer models (gated on the sentencepiece package).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dllama_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer  # noqa: E402
+
+
+def unicode_to_bytes() -> dict[str, int]:
+    """Inverse of GPT-2's byte-to-unicode table
+    (reference: convert-tokenizer-hf.py:12-23)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(2**8):
+        if b not in bs:
+            bs.append(b)
+            cs.append(2**8 + n)
+            n += 1
+    return dict(zip((chr(c) for c in cs), bs))
+
+
+def resolve_fast_tokenizer(dir_path: str) -> tuple[list[bytes], list[float], int | None, list[int] | None]:
+    from transformers import PreTrainedTokenizerFast
+
+    utb = unicode_to_bytes()
+    tokenizer = PreTrainedTokenizerFast(
+        tokenizer_file=os.path.join(dir_path, "tokenizer.json")
+    )
+    vocab_len = len(tokenizer.get_vocab())
+    tokens: list[bytes] = []
+    scores: list[float] = []
+    for i in range(vocab_len):
+        token_chars = list(tokenizer.convert_ids_to_tokens([i])[0])
+        token_bytes: list[int] = []
+        for ch in token_chars:
+            if ch in utb:
+                token_bytes.append(utb[ch])
+            else:
+                token_bytes += list(ch.encode("utf-8"))
+        tokens.append(bytes(token_bytes))
+        scores.append(-float(i))
+    bos_id = tokenizer.bos_token_id
+    eos_ids = [tokenizer.eos_token_id] if tokenizer.eos_token_id else None
+    return tokens, scores, bos_id, eos_ids
+
+
+def resolve_sentencepiece(dir_path: str):
+    try:
+        from sentencepiece import SentencePieceProcessor
+    except ImportError:
+        raise SystemExit(
+            "LlamaTokenizer conversion needs the sentencepiece package "
+            "(not installed in this environment); convert the fast-tokenizer "
+            "variant (tokenizer.json) instead"
+        )
+    processor = SentencePieceProcessor(
+        model_file=os.path.join(dir_path, "tokenizer.model")
+    )
+    tokens, scores = [], []
+    for i in range(processor.vocab_size()):
+        t = processor.id_to_piece(i).replace("▁", " ")
+        if len(t) == 6 and t.startswith("<0x") and t.endswith(">"):
+            b = bytes(bytearray.fromhex(t[3:-1]))
+        else:
+            b = t.encode("utf-8")
+        tokens.append(b)
+        scores.append(processor.get_score(i))
+    return tokens, scores, processor.bos_id(), [processor.eos_id()]
+
+
+def main() -> None:
+    if len(sys.argv) < 3:
+        print("Usage: python convert-tokenizer-hf.py <tokenizerFolderPath> <name>")
+        sys.exit(1)
+    dir_path, name = sys.argv[1], sys.argv[2]
+    with open(os.path.join(dir_path, "tokenizer_config.json")) as f:
+        tokenizer_config = json.load(f)
+
+    cls = tokenizer_config["tokenizer_class"]
+    if cls in ("PreTrainedTokenizerFast", "LlamaTokenizerFast", "Qwen2Tokenizer"):
+        tokens, scores, bos_id, eos_ids = resolve_fast_tokenizer(dir_path)
+    elif cls == "LlamaTokenizer":
+        tokens, scores, bos_id, eos_ids = resolve_sentencepiece(dir_path)
+    else:
+        raise SystemExit(f"Tokenizer {cls} is not supported")
+
+    if bos_id is None or eos_ids is None:
+        with open(os.path.join(dir_path, "config.json")) as f:
+            config = json.load(f)
+        if bos_id is None:
+            bos_id = config["bos_token_id"]
+        if eos_ids is None:
+            eos = config["eos_token_id"]
+            eos_ids = eos if isinstance(eos, list) else [eos]
+    if bos_id is None or eos_ids is None:
+        raise SystemExit("Cannot resolve bosId or eosIds")
+
+    print(f"bosId: {bos_id} ({tokens[bos_id]})")
+    for eos_id in eos_ids:
+        print(f"eosId: {eos_id} ({tokens[eos_id]})")
+
+    data = TokenizerData(
+        vocab=tokens,
+        scores=scores,
+        bos_id=bos_id,
+        add_bos=bool(tokenizer_config.get("add_bos_token", True)),
+        eos_token_ids=eos_ids,
+        chat_template=tokenizer_config.get("chat_template"),
+        max_token_length=max(len(t) for t in tokens),
+    )
+    output = f"dllama_tokenizer_{name}.t"
+    write_tokenizer(output, data)
+    print(f"✅ Created {output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Convert the original Llama 2 sentencepiece tokenizer.model to `.t`.
+
+Same CLI and output as the reference (converter/convert-tokenizer-llama2.py):
+
+    python convert-tokenizer-llama2.py <llama2FolderPath>
+
+Requires the sentencepiece package (gated: not installed in every image).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dllama_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer  # noqa: E402
+
+CHAT_TEMPLATE = (
+    "{% if messages[0]['role'] == 'system' %}{% set loop_messages = messages[1:] %}"
+    "{% set system_message = messages[0]['content'] %}{% else %}"
+    "{% set loop_messages = messages %}{% set system_message = false %}{% endif %}"
+    "{% for message in loop_messages %}"
+    "{% if (message['role'] == 'user') != (loop.index0 % 2 == 0) %}"
+    "{{ raise_exception('Conversation roles must alternate user/assistant/user/assistant/...') }}"
+    "{% endif %}{% if loop.index0 == 0 and system_message != false %}"
+    "{% set content = '<<SYS>>\\n' + system_message + '\\n<</SYS>>\\n\\n' + message['content'] %}"
+    "{% else %}{% set content = message['content'] %}{% endif %}"
+    "{% if message['role'] == 'user' %}{{ bos_token + '[INST] ' + content.strip() + ' [/INST]' }}"
+    "{% elif message['role'] == 'assistant' %}{{ ' '  + content.strip() + ' ' + eos_token }}"
+    "{% endif %}{% endfor %}"
+)
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        print("Usage: python convert-tokenizer-llama2.py <llama2FolderPath>")
+        sys.exit(1)
+    try:
+        from sentencepiece import SentencePieceProcessor
+    except ImportError:
+        raise SystemExit(
+            "convert-tokenizer-llama2.py needs the sentencepiece package "
+            "(not installed in this environment)"
+        )
+    processor = SentencePieceProcessor(
+        model_file=os.path.join(sys.argv[1], "tokenizer.model")
+    )
+    tokens: list[bytes] = []
+    scores: list[float] = []
+    for i in range(processor.vocab_size()):
+        piece = processor.id_to_piece(i).replace("▁", " ")
+        tokens.append(piece.encode("utf-8"))
+        scores.append(processor.get_score(i))
+    output = "dllama_tokenizer_llama2.t"
+    write_tokenizer(
+        output,
+        TokenizerData(
+            vocab=tokens,
+            scores=scores,
+            bos_id=processor.bos_id(),
+            add_bos=True,
+            eos_token_ids=[processor.eos_id()],
+            chat_template=CHAT_TEMPLATE,
+            max_token_length=max(len(t) for t in tokens),
+        ),
+    )
+    print(f"✅ Created {output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Convert the original Llama 3 tiktoken-style tokenizer.model to `.t`.
+
+Same CLI and output as the reference (converter/convert-tokenizer-llama3.py):
+
+    python convert-tokenizer-llama3.py <tokenizerPath>
+
+Input lines are `base64token rank`; scores are negated ranks; the 256
+reserved special tokens and the llama3 chat template are appended.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dllama_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer  # noqa: E402
+
+N_SPECIAL_TOKENS = 256
+SPECIAL_TOKENS = [
+    "<|begin_of_text|>",
+    "<|end_of_text|>",
+    "<|reserved_special_token_0|>",
+    "<|reserved_special_token_1|>",
+    "<|reserved_special_token_2|>",
+    "<|reserved_special_token_3|>",
+    "<|start_header_id|>",
+    "<|end_header_id|>",
+    "<|reserved_special_token_4|>",
+    "<|eot_id|>",
+] + [f"<|reserved_special_token_{i}|>" for i in range(5, N_SPECIAL_TOKENS - 5)]
+BOS_ID = 128000
+EOS_ID = 128001
+CHAT_EOS_ID = 128009
+CHAT_TEMPLATE = (
+    "{% set loop_messages = messages %}{% for message in loop_messages %}"
+    "{% set content = '<|start_header_id|>' + message['role'] + '<|end_header_id|>\n\n'"
+    "+ message['content'] | trim + '<|eot_id|>' %}{% if loop.index0 == 0 %}"
+    "{% set content = bos_token + content %}{% endif %}{{ content }}{% endfor %}"
+    "{% if add_generation_prompt %}"
+    "{{ '<|start_header_id|>assistant<|end_header_id|>\n\n' }}{% endif %}"
+)
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        print("Usage: python convert-tokenizer-llama3.py <tokenizerPath>")
+        sys.exit(1)
+    tokens: list[bytes] = []
+    scores: list[float] = []
+    with open(sys.argv[1]) as f:
+        for line in f:
+            b64, rank = line.split(" ")
+            tokens.append(base64.b64decode(b64))
+            scores.append(-float(rank))
+    index = len(tokens)
+    for tok in SPECIAL_TOKENS:
+        tokens.append(tok.encode("utf-8"))
+        scores.append(-float(index))
+        index += 1
+    output = "dllama_tokenizer_llama3.t"
+    write_tokenizer(
+        output,
+        TokenizerData(
+            vocab=tokens,
+            scores=scores,
+            bos_id=BOS_ID,
+            add_bos=True,
+            eos_token_ids=[EOS_ID, CHAT_EOS_ID],
+            chat_template=CHAT_TEMPLATE,
+            max_token_length=max(len(t) for t in tokens),
+        ),
+    )
+    print(f"✅ Created {output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -23,16 +23,11 @@ import time
 import jax
 
 
-def _build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(
-        prog="dllama-tpu",
-        description="TPU-native distributed-llama: tensor-parallel LLM inference",
-    )
-    p.add_argument("mode", choices=["inference", "chat", "perplexity", "worker"])
+def add_engine_args(p: argparse.ArgumentParser) -> None:
+    """Engine/model flags shared by the CLI and the API server
+    (reference flag surface: src/app.cpp:24-135)."""
     p.add_argument("--model", required=False)
     p.add_argument("--tokenizer", required=False)
-    p.add_argument("--prompt", default=None)
-    p.add_argument("--steps", type=int, default=0)
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--topp", type=float, default=0.9)
     p.add_argument("--seed", type=int, default=int(time.time()))
@@ -48,6 +43,17 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--chat-template", default=None, choices=[None, "llama2", "llama3", "deepSeek3", "chatml"])
     p.add_argument("--gpu-index", type=int, default=None)
     p.add_argument("--gpu-segments", default=None)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dllama-tpu",
+        description="TPU-native distributed-llama: tensor-parallel LLM inference",
+    )
+    p.add_argument("mode", choices=["inference", "chat", "perplexity", "worker"])
+    p.add_argument("--prompt", default=None)
+    p.add_argument("--steps", type=int, default=0)
+    add_engine_args(p)
     return p
 
 
@@ -71,7 +77,7 @@ def _resolve_tp(args) -> int:
     return 0  # auto: resolved against the model header in _load
 
 
-def _load(args):
+def load_engine(args):
     import jax.numpy as jnp
 
     from .runtime.engine import InferenceEngine
@@ -121,7 +127,7 @@ def _load(args):
 
 def run_inference(args) -> None:
     """(reference: dllama.cpp:13-116)"""
-    engine, tok = _load(args)
+    engine, tok = load_engine(args)
     if args.prompt is None:
         raise SystemExit("Prompt is required")
     if args.steps == 0:
@@ -176,7 +182,7 @@ def run_chat(args) -> None:
     """Interactive REPL (reference: dllama.cpp:174-258)."""
     from .tokenizer import ChatItem, ChatTemplateGenerator, ChatTemplateType, EosDetector, EosResult
 
-    engine, tok = _load(args)
+    engine, tok = load_engine(args)
     eos_piece = (
         tok.vocab[tok.eos_token_ids[0]].decode("utf-8", "replace")
         if tok.eos_token_ids
@@ -236,7 +242,7 @@ def run_perplexity(args) -> None:
     (reference: dllama.cpp:132-172)."""
     import numpy as np
 
-    engine, tok = _load(args)
+    engine, tok = load_engine(args)
     if args.prompt is None:
         raise SystemExit("Prompt is required")
     tokens = tok.encode(args.prompt, is_start=True, add_special_tokens=True)

@@ -131,6 +131,7 @@ class ApiState:
         engine, tok = self.engine, self.tokenizer
         engine.temperature = params.temperature
         engine.sampler.set_temp(params.temperature)
+        engine.sampler.set_topp(params.top_p)
         if params.seed is not None:
             engine.sampler.set_seed(params.seed)
 
@@ -170,6 +171,7 @@ class ApiState:
             padding_right=self.max_stop_len,
         )
 
+        hit_eos = False
         while pos < max_pred_pos:
             token, _ = engine.decode_step(token, pos)
             piece = tok.decode(token)
@@ -182,7 +184,17 @@ class ApiState:
                 detector.reset()
             pos += 1
             if eos_type == EosResult.EOS:
+                hit_eos = True
                 break
+
+        n_completion = pos - prompt_end_pos
+        if not hit_eos and pos < seq_len:
+            # max_tokens truncation: the last sampled token's text is in
+            # `buffer` but its KV entry was never written; run one KV-only
+            # step so a cached continuation resumes from a complete context
+            # (the reference skips this and silently degrades, dllama-api.cpp:470-475).
+            engine.decode_step(token, pos)
+            pos += 1
 
         message = ChatMessage("assistant", buffer)
         if pos >= seq_len:
@@ -196,7 +208,6 @@ class ApiState:
                 self.naive_cache.push(NaiveCacheItem(prompt_end_pos, m))
             self.naive_cache.push(NaiveCacheItem(pos, message))
 
-        n_completion = pos - prompt_end_pos
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
             "object": "chat.completion",
@@ -206,7 +217,7 @@ class ApiState:
                 {
                     "index": 0,
                     "message": {"role": "assistant", "content": buffer},
-                    "finish_reason": "stop",
+                    "finish_reason": "stop" if hit_eos else "length",
                 }
             ],
             "usage": {
@@ -218,7 +229,7 @@ class ApiState:
 
 
 def _chunk_payload(state: ApiState, delta: str | None, stop: bool) -> dict:
-    choice: dict = {"index": 0, "finish_reason": "stop" if stop else ""}
+    choice: dict = {"index": 0, "finish_reason": "stop" if stop else None}
     if not stop:
         choice["delta"] = {"role": "assistant", "content": delta}
     return {
@@ -329,7 +340,7 @@ def make_handler(state: ApiState):
             write_chunk(
                 f"data: {json.dumps(_chunk_payload(state, None, stop=True))}\r\n\r\n"
             )
-            write_chunk("data: [DONE]")
+            write_chunk("data: [DONE]\r\n\r\n")
             self.wfile.write(b"0\r\n\r\n")
 
         def _parse_params(self, body: dict) -> InferenceParams:
@@ -346,6 +357,8 @@ def make_handler(state: ApiState):
                 params.stream = bool(body["stream"])
             if "temperature" in body:
                 params.temperature = float(body["temperature"])
+            if "top_p" in body:
+                params.top_p = float(body["top_p"])
             if "seed" in body:
                 params.seed = int(body["seed"])
             if "max_tokens" in body:
@@ -376,53 +389,24 @@ def serve(
 
 def main(argv=None) -> None:
     import argparse
-
-    import jax
-    import jax.numpy as jnp
-
-    from ..cli import _resolve_tp
-
-    parser = argparse.ArgumentParser(prog="dllama-tpu-api")
-    parser.add_argument("--model", required=True)
-    parser.add_argument("--tokenizer", required=True)
-    parser.add_argument("--host", default="0.0.0.0")
-    parser.add_argument("--port", type=int, default=9990)
-    parser.add_argument("--temperature", type=float, default=0.8)
-    parser.add_argument("--topp", type=float, default=0.9)
-    parser.add_argument("--seed", type=int, default=int(time.time()))
-    parser.add_argument("--max-seq-len", type=int, default=0)
-    parser.add_argument("--tp", type=int, default=0)
-    parser.add_argument("--workers", nargs="*", default=None)
-    parser.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
-    parser.add_argument("--nthreads", type=int, default=1)
-    parser.add_argument("--buffer-float-type", default="q80")
-    parser.add_argument("--gpu-index", type=int, default=None)
-    parser.add_argument("--gpu-segments", default=None)
-    args = parser.parse_args(argv)
-
     import os
 
+    import jax
+
+    from ..cli import add_engine_args, load_engine
+
+    parser = argparse.ArgumentParser(prog="dllama-tpu-api")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9990)
+    add_engine_args(parser)
+    args = parser.parse_args(argv)
+
+    # This environment's TPU platform plugin wins over the JAX_PLATFORMS env
+    # var; re-assert the user's choice through the config API.
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    tok = Tokenizer(args.tokenizer)
-    tp = _resolve_tp(args)
-    if tp == 0:
-        from ..parallel.mesh import auto_tp
-
-        tp = auto_tp(args.model)
-    engine = InferenceEngine(
-        args.model,
-        tokenizer=tok,
-        tp=tp,
-        dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
-        max_seq_len=args.max_seq_len,
-        temperature=args.temperature,
-        topp=args.topp,
-        seed=args.seed,
-    )
-    import os.path
-
+    engine, tok = load_engine(args)
     server = serve(
         engine,
         tok,

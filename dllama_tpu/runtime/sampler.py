@@ -80,6 +80,9 @@ class Sampler:
     def set_temp(self, temperature: float) -> None:
         self.temperature = temperature
 
+    def set_topp(self, topp: float) -> None:
+        self.topp = topp
+
     def set_seed(self, seed: int) -> None:
         self.rng = XorshiftRng(seed)
 

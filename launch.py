@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Model downloader / launcher.
+
+Same registry and CLI shape as the reference's launch.py: downloads
+prequantized `.m`/`.t` artifacts (multi-part, resumable) from the
+distributed-llama HuggingFace repos — the formats are wire-compatible, so
+the same artifacts drive this framework — then prints/writes the run
+command (TPU flavor: `python -m dllama_tpu ... --tp N`).
+
+    python launch.py <model> [-y] [--tp N]
+    python launch.py          # list models
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+from urllib.request import urlopen
+
+
+def parts(length: int) -> list[str]:
+    return [chr(97 + i // 26) + chr(97 + i % 26) for i in range(length)]
+
+
+def hf(repo: str, file: str) -> str:
+    return f"https://huggingface.co/{repo}/resolve/main/{file}?download=true"
+
+
+# name -> (model-urls, tokenizer-url, run-mode, extra-args)
+# registry mirrors the reference launch.py:17-73
+MODELS = {
+    "llama3_1_8b_instruct_q40": (
+        [hf("b4rtaz/Llama-3_1-8B-Q40-Instruct-Distributed-Llama", "dllama_model_llama3.1_instruct_q40.m")],
+        hf("b4rtaz/Llama-3_1-8B-Q40-Instruct-Distributed-Llama", "dllama_tokenizer_llama_3_1.t"),
+        "chat", "--max-seq-len 4096",
+    ),
+    "llama3_1_405b_instruct_q40": (
+        [hf("b4rtaz/Llama-3_1-405B-Q40-Instruct-Distributed-Llama", f"dllama_model_llama31_405b_q40_{s}") for s in parts(56)],
+        hf("b4rtaz/Llama-3_1-405B-Q40-Instruct-Distributed-Llama", "dllama_tokenizer_llama_3_1.t"),
+        "chat", "--max-seq-len 4096",
+    ),
+    "llama3_2_1b_instruct_q40": (
+        [hf("b4rtaz/Llama-3_2-1B-Q40-Instruct-Distributed-Llama", "dllama_model_llama3.2-1b-instruct_q40.m")],
+        hf("b4rtaz/Llama-3_2-1B-Q40-Instruct-Distributed-Llama", "dllama_tokenizer_llama3_2.t"),
+        "chat", "--max-seq-len 4096",
+    ),
+    "llama3_2_3b_instruct_q40": (
+        [hf("b4rtaz/Llama-3_2-3B-Q40-Instruct-Distributed-Llama", "dllama_model_llama3.2-3b-instruct_q40.m")],
+        hf("b4rtaz/Llama-3_2-3B-Q40-Instruct-Distributed-Llama", "dllama_tokenizer_llama3_2.t"),
+        "chat", "--max-seq-len 4096",
+    ),
+    "llama3_3_70b_instruct_q40": (
+        [hf("b4rtaz/Llama-3_3-70B-Q40-Instruct-Distributed-Llama", f"dllama_model_llama-3.3-70b_q40{s}") for s in parts(11)],
+        hf("b4rtaz/Llama-3_3-70B-Q40-Instruct-Distributed-Llama", "dllama_tokenizer_llama-3.3-70b.t"),
+        "chat", "--max-seq-len 4096",
+    ),
+    "deepseek_r1_distill_llama_8b_q40": (
+        [hf("b4rtaz/DeepSeek-R1-Distill-Llama-8B-Distributed-Llama", "dllama_model_deepseek-r1-distill-llama-8b_q40.m")],
+        hf("b4rtaz/DeepSeek-R1-Distill-Llama-8B-Distributed-Llama", "dllama_tokenizer_deepseek-r1-distill-llama-8b.t"),
+        "chat", "--max-seq-len 4096",
+    ),
+    "qwen3_0.6b_q40": (
+        [hf("b4rtaz/Qwen3-0.6B-Q40-Distributed-Llama", "dllama_model_qwen3_0.6b_q40.m")],
+        hf("b4rtaz/Qwen3-0.6B-Q40-Distributed-Llama", "dllama_tokenizer_qwen3_0.6b.t"),
+        "chat", "--max-seq-len 4096",
+    ),
+    "qwen3_1.7b_q40": (
+        [hf("b4rtaz/Qwen3-1.7B-Q40-Distributed-Llama", "dllama_model_qwen3_1.7b_q40.m")],
+        hf("b4rtaz/Qwen3-1.7B-Q40-Distributed-Llama", "dllama_tokenizer_qwen3_1.7b.t"),
+        "chat", "--max-seq-len 4096",
+    ),
+    "qwen3_8b_q40": (
+        [hf("b4rtaz/Qwen3-8B-Q40-Distributed-Llama", "dllama_model_qwen3_8b_q40.m")],
+        hf("b4rtaz/Qwen3-8B-Q40-Distributed-Llama", "dllama_tokenizer_qwen3_8b.t"),
+        "chat", "--max-seq-len 4096",
+    ),
+    "qwen3_14b_q40": (
+        [hf("b4rtaz/Qwen3-14B-Q40-Distributed-Llama", f"dllama_model_qwen3_14b_q40_{s}") for s in parts(2)],
+        hf("b4rtaz/Qwen3-14B-Q40-Distributed-Llama", "dllama_tokenizer_qwen3_14b.t"),
+        "chat", "--max-seq-len 4096",
+    ),
+    "qwen3_30b_a3b_q40": (
+        [hf("b4rtaz/Qwen3-30B-A3B-Q40-Distributed-Llama", f"dllama_model_qwen3_30b_a3b_{s}") for s in parts(5)],
+        hf("b4rtaz/Qwen3-30B-A3B-Q40-Distributed-Llama", "dllama_tokenizer_qwen3_30b_a3b.t"),
+        "chat", "--max-seq-len 4096",
+    ),
+}
+
+
+def confirm(message: str) -> bool:
+    if "-y" in sys.argv:
+        return True
+    return input(f'❓ {message} ("Y" if yes): ').upper() in ("Y", "YES")
+
+
+def download_file(urls: list[str], path: str) -> None:
+    """Multi-part download with retry + resume within a part
+    (reference: launch.py:82-124)."""
+    if os.path.isfile(path):
+        if not confirm(f"{os.path.basename(path)} already exists, download again?"):
+            return
+    socket.setdefaulttimeout(30)
+    with open(path, "wb") as f:
+        for url in urls:
+            start = f.tell()
+            ok = False
+            for attempt in range(8):
+                print(f"📄 {url} (attempt: {attempt})")
+                try:
+                    f.seek(start)
+                    with urlopen(url) as response:
+                        while True:
+                            chunk = response.read(1 << 16)
+                            if not chunk:
+                                break
+                            f.write(chunk)
+                            mb = f.tell() // (1024 * 1024)
+                            if mb % 100 == 0:
+                                print(f"\r📦 {mb} MB downloaded", end="", flush=True)
+                    print()
+                    ok = True
+                    break
+                except Exception as e:
+                    print(f"\n⚠️  {e}; retrying")
+            if not ok:
+                raise SystemExit(f"download failed: {url}")
+    print(f"✅ {path}")
+
+
+def main() -> None:
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    if not args:
+        print("Usage: python launch.py <model> [-y] [--tp N]")
+        print()
+        print("Available models:")
+        for name in MODELS:
+            print(f"  {name}")
+        sys.exit(1)
+    name = args[0]
+    if name not in MODELS:
+        raise SystemExit(f"unknown model: {name}")
+    tp = ""
+    if "--tp" in sys.argv:
+        tp = f" --tp {sys.argv[sys.argv.index('--tp') + 1]}"
+
+    model_urls, tok_url, mode, extra = MODELS[name]
+    os.makedirs("models", exist_ok=True)
+    model_path = f"models/dllama_model_{name}.m"
+    tok_path = f"models/dllama_tokenizer_{name}.t"
+    download_file(model_urls, model_path)
+    download_file([tok_url], tok_path)
+
+    cmd = (
+        f"python -m dllama_tpu {mode} --model {model_path} "
+        f"--tokenizer {tok_path} {extra}{tp}"
+    )
+    script = f"run_{name}.sh"
+    with open(script, "w") as f:
+        f.write("#!/bin/sh\n" + cmd + "\n")
+    os.chmod(script, 0o755)
+    print(f"To run the model, execute: ./{script}")
+    print(f"   {cmd}")
+    if confirm("Do you want to run the model now?"):
+        os.system(cmd)
+
+
+if __name__ == "__main__":
+    main()
