@@ -43,6 +43,8 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--chat-template", default=None, choices=[None, "llama2", "llama3", "deepSeek3", "chatml"])
     p.add_argument("--gpu-index", type=int, default=None)
     p.add_argument("--gpu-segments", default=None)
+    p.add_argument("--weight-format", default="auto", choices=["auto", "q40", "dense"],
+                   help="q40 keeps weights block-quantized on device (Pallas kernel)")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -106,6 +108,7 @@ def load_engine(args):
         topp=args.topp,
         seed=args.seed,
         prefill_buckets=tuple(sorted({1, args.nbatches, 512})),
+        weight_format=args.weight_format,
     )
     h = engine.header
     print(f"💡 Arch: {h.arch.name}")
@@ -258,7 +261,9 @@ def run_perplexity(args) -> None:
     cache = engine._fresh_cache()
     t = len(tokens)
     arr = jnp.asarray([tokens] * engine.batch_size, dtype=jnp.int32)
-    logits, _ = forward(engine.params, engine.header, arr, jnp.int32(0), cache)
+    logits, _ = forward(
+        engine.params, engine.header, arr, jnp.int32(0), cache, mesh=engine.mesh
+    )
     lg = np.asarray(logits, dtype=np.float32)[0]  # [T, V]
     logprobs = lg - np.log(np.exp(lg - lg.max(-1, keepdims=True)).sum(-1, keepdims=True)) - lg.max(-1, keepdims=True)
     nll = -np.mean([logprobs[i, tokens[i + 1]] for i in range(t - 1)])

@@ -81,6 +81,7 @@ def random_params(
     seed: int = 0,
     mesh=None,
     put=None,  # kept for API symmetry with load_params; unused when mesh given
+    weight_format: str = "dense",
 ) -> Params:
     """Random params pytree with the loader's exact layout, generated
     directly ON DEVICE (jit + out_shardings): no multi-GB host->device
@@ -123,6 +124,30 @@ def random_params(
         )
         return f(key)
 
+    def mk_quant(name, *shape):
+        """Random QuantWeight [..., in, out] on device: int8 values in
+        [-8, 7] + f32 per-block scales (the loader's q40 layout)."""
+        import zlib
+
+        from ..ops.quant_matmul import QuantWeight
+
+        sh = sharding_for(name)
+        *lead, inner, out = shape
+        key = jax.random.fold_in(root_key, zlib.crc32(name.encode()))
+        kq, kd = jax.random.split(key)
+        q = jax.jit(
+            lambda k: jax.random.randint(k, shape, -8, 8, dtype=jnp.int8),
+            out_shardings=sh,
+        )(kq)
+        d_shape = (*lead, inner // 32, out)
+        d = jax.jit(
+            lambda k: jax.random.uniform(
+                k, d_shape, jnp.float32, minval=0.5 * scale / 8, maxval=scale / 8
+            ),
+            out_shardings=sh,
+        )(kd)
+        return QuantWeight(q, d)
+
     def dev(name, arr):
         sh = sharding_for(name)
         arr = jnp.asarray(arr)
@@ -133,16 +158,19 @@ def random_params(
     moe = h.arch == LlmArch.QWEN3_MOE
     E = h.n_experts
 
+    quant = weight_format == "q40"
+    mm = mk_quant if quant else mk
     layers = {
         "att_norm": mk("att_norm", L, D, norm=True),
         "ffn_norm": mk("ffn_norm", L, D, norm=True),
-        "wq": mk("wq", L, D, QD),
-        "wk": mk("wk", L, D, KD),
-        "wv": mk("wv", L, D, KD),
-        "wo": mk("wo", L, QD, D),
-        "w1": mk("w1", L, E, D, FF) if moe else mk("w1", L, D, FF),
-        "w2": mk("w2", L, E, FF, D) if moe else mk("w2", L, FF, D),
-        "w3": mk("w3", L, E, D, FF) if moe else mk("w3", L, D, FF),
+        "wq": mm("wq", L, D, QD),
+        "wk": mm("wk", L, D, KD),
+        "wv": mm("wv", L, D, KD),
+        "wo": mm("wo", L, QD, D),
+        # MoE experts stay dense (same policy as the loader)
+        "w1": mk("w1", L, E, D, FF) if moe else mm("w1", L, D, FF),
+        "w2": mk("w2", L, E, FF, D) if moe else mm("w2", L, FF, D),
+        "w3": mk("w3", L, E, D, FF) if moe else mm("w3", L, D, FF),
     }
     if moe:
         gate_key = jax.random.fold_in(root_key, 12345)
@@ -157,7 +185,7 @@ def random_params(
     cos, sin = rope_cache(h)
     return {
         "embed": mk("embed", V, D),
-        "wcls": mk("wcls", D, V),
+        "wcls": mm("wcls", D, V),
         "final_norm": mk("final_norm", D, norm=True),
         "rope_cos": dev("rope_cos", cos),
         "rope_sin": dev("rope_sin", sin),

@@ -31,11 +31,21 @@ from jax import lax
 
 from ..formats.model_file import HiddenAct, LlmArch, LlmHeader, RopeType
 from ..ops.jnp_ops import apply_rope, gelu, qk_rms_norm, rms_norm, silu
+from ..ops.quant_matmul import QuantWeight, qmatmul_tp
 
 Params = Dict[str, Any]
 KvCache = Dict[str, jnp.ndarray]
 
 _NEG_INF = -1e30
+
+
+def _mm(x: jnp.ndarray, w, role: str, mesh) -> jnp.ndarray:
+    """Matmul dispatch: dense [in, out] weights take the einsum path (GSPMD
+    partitions them via the NamedSharding specs); Q40 QuantWeight leaves take
+    the Pallas kernel (shard_map'd per TP role on a mesh)."""
+    if isinstance(w, QuantWeight):
+        return qmatmul_tp(x, w, role, mesh).astype(x.dtype)
+    return jnp.einsum("bti,io->bto", x, w)
 
 
 def init_kv_cache(
@@ -136,12 +146,17 @@ def forward(
     tokens: jnp.ndarray,  # [B, T] int32
     pos: jnp.ndarray,  # scalar int32
     cache: KvCache,
+    mesh=None,
 ) -> Tuple[jnp.ndarray, KvCache]:
     """Run the decoder on T tokens starting at absolute position `pos`.
 
     Returns (logits [B, T, V] f32, updated cache). Jit-safe: T is static,
     `pos` is a traced scalar. Layers run under `lax.scan` over the stacked
     layer parameters so compile time is O(1) in depth.
+
+    `mesh` is only consulted by the quantized (Pallas) matmul path, which
+    needs explicit shard_map partitioning; the dense path is GSPMD-managed
+    and ignores it.
     """
     b, t = tokens.shape
     interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
@@ -158,15 +173,9 @@ def forward(
 
         # -- attention block (reference: src/llm.cpp:263-403) --
         y = rms_norm(x, lp["att_norm"], h.norm_epsilon)
-        q = jnp.einsum("btd,dq->btq", y, lp["wq"]).reshape(
-            b, t, h.n_heads, h.head_dim
-        )
-        k = jnp.einsum("btd,dk->btk", y, lp["wk"]).reshape(
-            b, t, h.n_kv_heads, h.head_dim
-        )
-        v = jnp.einsum("btd,dk->btk", y, lp["wv"]).reshape(
-            b, t, h.n_kv_heads, h.head_dim
-        )
+        q = _mm(y, lp["wq"], "row", mesh).reshape(b, t, h.n_heads, h.head_dim)
+        k = _mm(y, lp["wk"], "row", mesh).reshape(b, t, h.n_kv_heads, h.head_dim)
+        v = _mm(y, lp["wv"], "row", mesh).reshape(b, t, h.n_kv_heads, h.head_dim)
         if is_qwen3:
             q = qk_rms_norm(q, lp["q_norm"], h.norm_epsilon)
             k = qk_rms_norm(k, lp["k_norm"], h.norm_epsilon)
@@ -183,7 +192,7 @@ def forward(
         )
 
         z = _attention(q, k_cache_l, v_cache_l, pos, h.head_dim)
-        x = x + jnp.einsum("btq,qd->btd", z, lp["wo"]).astype(x.dtype)
+        x = x + _mm(z, lp["wo"], "col", mesh).astype(x.dtype)
 
         # -- FFN block (reference: src/llm.cpp:405-557) --
         y = rms_norm(x, lp["ffn_norm"], h.norm_epsilon)
@@ -198,9 +207,9 @@ def forward(
                 act,
             )
         else:
-            d = act(jnp.einsum("btd,df->btf", y, lp["w1"]))
-            l = jnp.einsum("btd,df->btf", y, lp["w3"])
-            f = jnp.einsum("btf,fd->btd", d * l.astype(d.dtype), lp["w2"])
+            d = act(_mm(y, lp["w1"], "row", mesh))
+            l = _mm(y, lp["w3"], "row", mesh)
+            f = _mm(d * l.astype(d.dtype), lp["w2"], "col", mesh)
         x = x + f.astype(x.dtype)
         return x, (k_cache_l, v_cache_l)
 
@@ -210,5 +219,11 @@ def forward(
 
     # final norm + logits (reference: src/llm.cpp:560-599)
     y = rms_norm(x, params["final_norm"], h.norm_epsilon)
-    logits = jnp.einsum("btd,dv->btv", y.astype(jnp.float32), params["wcls"].astype(jnp.float32))
+    wcls = params["wcls"]
+    if isinstance(wcls, QuantWeight):
+        logits = qmatmul_tp(y, wcls, "row", mesh)
+    else:
+        logits = jnp.einsum(
+            "btd,dv->btv", y.astype(jnp.float32), wcls.astype(jnp.float32)
+        )
     return logits, {"k": k_new, "v": v_new}

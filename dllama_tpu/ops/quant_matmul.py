@@ -1,0 +1,248 @@
+"""Q40 weight-quantized matmul: Pallas TPU kernel + jnp reference.
+
+The reference's hottest kernel is the Q80-activation x Q40-weight int dot
+(src/nn/nn-cpu-ops.cpp:231-449). On TPU the right design is different
+(SURVEY.md §7 translation table): weights stay block-quantized in HBM
+(int8 values + per-32-block scales — 0.56 B/elem vs 2 for bf16) and are
+dequantized INSIDE the kernel after the HBM->VMEM copy, feeding the MXU in
+bf16. Decode-step matmuls are HBM-bandwidth-bound, so the ~3.6x traffic
+reduction is the win; the reference's int8 activation quantization was a
+CPU SIMD trick, not a quality choice, and is deliberately not reproduced
+(activations ride in bf16; accumulation is f32 like the reference).
+
+Device layout — chosen for the TPU (sublane, lane) tiling: weights are
+stored TRANSPOSED relative to the `.m` file, ``q`` int8 [in, out] with the
+contraction (in) axis on sublanes. The 32-element quant blocks then run
+along sublanes, so the in-kernel dequant is a sublane-broadcast multiply
+(a lane-dim reshape would be an unsupported Mosaic shape cast):
+
+    w[i, o] = q[i, o] * d[i // 32, o]        # d: [in // 32, out]
+
+and the MXU consumes ``x [m, in] @ w [in, out]`` directly, no transpose.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Q_BLOCK = 32
+
+
+class QuantWeight(NamedTuple):
+    """Planar Q40 tensor in device layout (a pytree; scan/device_put compose).
+
+    ``q`` int8 [..., in, out] with values in [-8, 7];
+    ``d`` f32 [..., in // 32, out] per-block scales (f32 holds the wire's
+    f16 values exactly; bf16 would round them — scale bytes are ~2% of the
+    tensor so the traffic cost is noise).
+    """
+
+    q: jnp.ndarray
+    d: jnp.ndarray
+
+    @property
+    def in_dim(self) -> int:
+        return self.q.shape[-2]
+
+    @property
+    def out_dim(self) -> int:
+        return self.q.shape[-1]
+
+
+def planar_to_device_layout(
+    q_out_in: np.ndarray, d_out_blocks: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side layout transform from `q40_to_planar` output ([out, in]
+    values, [out, in//32] f16 scales) to the kernel layout: transpose so the
+    contraction axis leads, scales widened to f32."""
+    q = np.ascontiguousarray(np.swapaxes(q_out_in, -1, -2))
+    d = np.ascontiguousarray(np.swapaxes(d_out_blocks, -1, -2)).astype(np.float32)
+    return q, d
+
+
+def from_planar(q_out_in: np.ndarray, d_out_blocks: np.ndarray) -> QuantWeight:
+    """Device QuantWeight from `q40_to_planar` output."""
+    q, d = planar_to_device_layout(q_out_in, d_out_blocks)
+    return QuantWeight(jnp.asarray(q), jnp.asarray(d))
+
+
+def dequant(w: QuantWeight, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """[..., in, out] dense tensor (jnp reference semantics of
+    nn-quants.cpp:229-246)."""
+    *lead, inner, out = w.q.shape
+    q = w.q.astype(jnp.float32).reshape(*lead, inner // Q_BLOCK, Q_BLOCK, out)
+    dense = q * w.d.astype(jnp.float32)[..., :, None, :]
+    return dense.reshape(*lead, inner, out).astype(dtype)
+
+
+def qmatmul_ref(x: jnp.ndarray, w: QuantWeight) -> jnp.ndarray:
+    """Reference path: dequant + dense matmul. x [..., in] -> [..., out] f32.
+    Used for equivalence tests and as the off-TPU fallback."""
+    dense = dequant(w, jnp.float32)
+    return jnp.einsum("...i,io->...o", x.astype(jnp.float32), dense)
+
+
+def _qmm_kernel(x_ref, q_ref, d_ref, o_ref, acc_ref, *, n_k: int):
+    """One (m, block_n) output tile, accumulated over k blocks in VMEM
+    scratch: sublane-broadcast dequant then MXU."""
+    pk = pl.program_id(1)
+    q = q_ref[:]  # [bk, bn] int8
+    d = d_ref[:]  # [bk // 32, bn] f32
+    bk, bn = q.shape
+    w = (
+        (
+            q.astype(jnp.float32).reshape(bk // Q_BLOCK, Q_BLOCK, bn)
+            * d[:, None, :]
+        )
+        .reshape(bk, bn)
+        .astype(jnp.bfloat16)
+    )
+    partial_out = jax.lax.dot_general(
+        x_ref[:],
+        w,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(pk == 0)
+    def _init():
+        acc_ref[:] = partial_out
+
+    @pl.when(pk > 0)
+    def _accum():
+        acc_ref[:] += partial_out
+
+    @pl.when(pk == n_k - 1)
+    def _emit():
+        o_ref[:] = acc_ref[:]
+
+
+def _pick_block(n: int, preferred: int) -> int:
+    """Largest 128-multiple <= preferred that divides n (vocab dims like
+    151936 aren't multiples of 256)."""
+    for b in range(min(preferred, n), 0, -128):
+        if n % b == 0:
+            return b
+    return n  # fall back to a single block
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_n", "block_k", "interpret")
+)
+def qmatmul_2d(
+    x: jnp.ndarray,  # [m, k]
+    q: jnp.ndarray,  # [k, n] int8
+    d: jnp.ndarray,  # [k // 32, n] f32
+    block_n: int = 512,
+    block_k: int = 2048,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas quantized matmul on 2D operands; returns [m, n] f32."""
+    m, k = x.shape
+    n = q.shape[1]
+    assert q.shape == (k, n) and d.shape == (k // Q_BLOCK, n), (q.shape, d.shape)
+    bn = _pick_block(n, block_n)
+    bk = _pick_block(k, block_k)
+    assert bk % Q_BLOCK == 0
+    if d.dtype != jnp.float32:
+        d = d.astype(jnp.float32)
+
+    n_k = k // bk
+    grid = (n // bn, n_k)  # k innermost: the accumulator tile stays live
+    return pl.pallas_call(
+        functools.partial(_qmm_kernel, n_k=n_k),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((m, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((bk, bn), lambda i, j: (j, i)),
+            pl.BlockSpec((bk // Q_BLOCK, bn), lambda i, j: (j, i)),
+        ],
+        out_specs=pl.BlockSpec((m, bn), lambda i, j: (0, i)),
+        scratch_shapes=[pltpu.VMEM((m, bn), jnp.float32)],
+        interpret=interpret,
+    )(x.astype(jnp.bfloat16), q, d)
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def qmatmul(x: jnp.ndarray, w: QuantWeight, block_n: int = 512) -> jnp.ndarray:
+    """x [..., in] @ W -> [..., out] f32, auto-flattening leading dims.
+
+    Dispatches to the Pallas kernel on TPU; off-TPU (CPU test meshes) uses
+    the dequant reference path — pallas interpret mode is orders of
+    magnitude slower and numerically identical anyway.
+    """
+    *lead, k = x.shape
+    if not _use_pallas():
+        return qmatmul_ref(x, w)
+    m = 1
+    for s in lead:
+        m *= s
+    out = qmatmul_2d(x.reshape(m, k), w.q, w.d, block_n=block_n)
+    return out.reshape(*lead, w.out_dim)
+
+
+def qmatmul_tp(
+    x: jnp.ndarray,  # [B, T, in]
+    w: QuantWeight,  # [in, out] (+ scales), possibly tp-sharded
+    role: str,  # "row" (out split) | "col" (in split, partial-sum psum)
+    mesh=None,
+) -> jnp.ndarray:
+    """Tensor-parallel quantized matmul.
+
+    GSPMD cannot partition a `pallas_call`, so on a multi-device mesh the
+    kernel runs per-shard under `shard_map` with the TP layout made
+    explicit — the manual-collective restatement of the reference's design:
+    row-split needs no collective (the all-gather the reference does per
+    block is deferred to the residual psum), col-split partial sums psum
+    over ICI exactly where the reference ran SYNC_NODE_SLICES + OP_MERGE_ADD
+    (src/llm.cpp:403,554).
+
+    Off TPU this degrades to the dequant einsum and lets GSPMD shard it.
+    """
+    if not _use_pallas():
+        return qmatmul_ref(x, w)
+    if mesh is None or mesh.devices.size == 1:
+        return qmatmul(x, w)
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if role == "row":
+        in_specs = (
+            P("dp", None, None),
+            P(None, "tp"),
+            P(None, "tp"),
+        )
+        out_spec = P("dp", None, "tp")
+
+        def f(xx, qq, dd):
+            return qmatmul(xx, QuantWeight(qq, dd))
+
+    elif role == "col":
+        in_specs = (
+            P("dp", None, "tp"),
+            P("tp", None),
+            P("tp", None),
+        )
+        out_spec = P("dp", None, None)
+
+        def f(xx, qq, dd):
+            return jax.lax.psum(qmatmul(xx, QuantWeight(qq, dd)), "tp")
+
+    else:
+        raise ValueError(f"unknown role: {role}")
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_spec, check_rep=False
+    )(x, w.q, w.d)

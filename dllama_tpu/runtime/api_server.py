@@ -109,6 +109,10 @@ class ApiState:
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
+        # request defaults captured once: per-request sampler mutations must
+        # not leak into later requests' defaults
+        self.default_temperature = engine.temperature
+        self.default_top_p = engine.sampler.topp
         stops = [
             tokenizer.vocab[t].decode("utf-8", "replace")
             for t in tokenizer.eos_token_ids
@@ -228,8 +232,10 @@ class ApiState:
         }
 
 
-def _chunk_payload(state: ApiState, delta: str | None, stop: bool) -> dict:
-    choice: dict = {"index": 0, "finish_reason": "stop" if stop else None}
+def _chunk_payload(
+    state: ApiState, delta: str | None, stop: bool, reason: str = "stop"
+) -> dict:
+    choice: dict = {"index": 0, "finish_reason": reason if stop else None}
     if not stop:
         choice["delta"] = {"role": "assistant", "content": delta}
     return {
@@ -329,8 +335,10 @@ def make_handler(state: ApiState):
                 payload = _chunk_payload(state, delta, stop=False)
                 write_chunk(f"data: {json.dumps(payload)}\r\n\r\n")
 
+            finish_reason = "stop"
             try:
-                state.complete(params, emit=emit)
+                result = state.complete(params, emit=emit)
+                finish_reason = result["choices"][0]["finish_reason"]
             except Exception as e:
                 # headers are already sent; deliver the error in-stream so
                 # the client still gets a well-formed SSE termination
@@ -338,7 +346,9 @@ def make_handler(state: ApiState):
                     f"data: {json.dumps({'error': {'message': str(e)}})}\r\n\r\n"
                 )
             write_chunk(
-                f"data: {json.dumps(_chunk_payload(state, None, stop=True))}\r\n\r\n"
+                "data: "
+                + json.dumps(_chunk_payload(state, None, True, finish_reason))
+                + "\r\n\r\n"
             )
             write_chunk("data: [DONE]\r\n\r\n")
             self.wfile.write(b"0\r\n\r\n")
@@ -346,8 +356,8 @@ def make_handler(state: ApiState):
         def _parse_params(self, body: dict) -> InferenceParams:
             """(reference: parseRequest, src/dllama-api.cpp:491-520)"""
             params = InferenceParams(
-                temperature=state.engine.temperature,
-                top_p=state.engine.sampler.topp,
+                temperature=state.default_temperature,
+                top_p=state.default_top_p,
                 stop=[],
             )
             params.messages = [

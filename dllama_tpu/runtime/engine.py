@@ -19,10 +19,12 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..formats.model_file import LlmHeader, ModelReader
+from ..formats.quants import FloatType
 from ..models import forward, init_kv_cache, load_params
 from ..parallel import cache_specs, make_mesh, shard_params_put, validate_tp
 from ..tokenizer import Tokenizer
@@ -58,6 +60,7 @@ class InferenceEngine:
         seed: int = 12345,
         prefill_buckets: tuple[int, ...] = DEFAULT_PREFILL_BUCKETS,
         matmul_precision: str | None = None,
+        weight_format: str = "auto",
     ):
         self.reader = ModelReader(model_path, max_seq_len=max_seq_len)
         self.header: LlmHeader = self.reader.header
@@ -75,8 +78,37 @@ class InferenceEngine:
             b for b in sorted(prefill_buckets) if b <= self.header.seq_len
         ) or (1,)
 
+        # "auto": keep Q40 weights quantized on device when the Pallas path
+        # is available (TPU); dense bf16/f32 elsewhere (the CPU fallback
+        # dequantizes per call, fine for tests, slow for serving).
+        if weight_format == "auto":
+            weight_format = (
+                "q40"
+                if (
+                    self.header.weight_type == FloatType.Q40
+                    and jax.default_backend() == "tpu"
+                )
+                else "dense"
+            )
+        self.weight_format = weight_format
+        if weight_format == "q40" and tp > 1:
+            # col-split quant weights shard the scale tensor's block axis
+            # (in//32): every contraction dim must divide by 32*tp
+            for dim_name, dim in [
+                ("dim", self.header.dim),
+                ("qDim", self.header.q_dim),
+                ("hiddenDim", self.header.ff_dim),
+            ]:
+                if dim % (32 * tp) != 0:
+                    raise ValueError(
+                        f"q40 weight format with tp={tp} needs {dim_name} "
+                        f"divisible by {32 * tp}, got {dim}"
+                    )
         self.params = load_params(
-            self.reader, dtype=dtype, put=shard_params_put(self.mesh, self.header)
+            self.reader,
+            dtype=dtype,
+            put=shard_params_put(self.mesh, self.header),
+            weight_format=weight_format,
         )
         self._cache_sharding = {
             k: NamedSharding(self.mesh, spec)
@@ -108,6 +140,8 @@ class InferenceEngine:
         h = self.header
         precision = self._precision
 
+        mesh = self.mesh
+
         @partial(jax.jit, donate_argnums=(2,))
         def step(params, tokens, cache, pos):
             ctx = (
@@ -116,7 +150,7 @@ class InferenceEngine:
                 else contextlib.nullcontext()
             )
             with ctx:
-                logits, cache = forward(params, h, tokens, pos, cache)
+                logits, cache = forward(params, h, tokens, pos, cache, mesh=mesh)
             last = logits[:, -1, :]
             if greedy:
                 # On-device sampling (reference samples on host from the
@@ -127,6 +161,52 @@ class InferenceEngine:
 
         self._compiled[key] = step
         return step
+
+    def _decode_block_fn(self, n_steps: int):
+        """Jitted on-device greedy decode of `n_steps` tokens: the sample ->
+        feed-back loop runs under `lax.fori_loop`, so the host pays one
+        dispatch per block instead of one per token (host->device dispatch
+        costs ~10ms/step when the chip sits behind a tunnel; this is the
+        lax.fori_loop multi-step plan from SURVEY.md §7 hard parts)."""
+        key = ("block", n_steps)
+        if key in self._compiled:
+            return self._compiled[key]
+        h = self.header
+        mesh = self.mesh
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def block(params, token, cache, pos):
+            def body(i, carry):
+                tok, cache, out = carry
+                logits, cache = forward(params, h, tok, pos + i, cache, mesh=mesh)
+                nxt = (
+                    jnp.argmax(logits[:, -1, :], axis=-1)
+                    .astype(jnp.int32)
+                    .reshape(-1, 1)
+                )
+                out = lax.dynamic_update_index_in_dim(out, nxt[:, 0], i, axis=0)
+                return nxt, cache, out
+
+            out0 = jnp.zeros((n_steps, token.shape[0]), jnp.int32)
+            tok, cache, out = lax.fori_loop(
+                0, n_steps, body, (token, cache, out0)
+            )
+            return out, cache
+
+        self._compiled[key] = block
+        return block
+
+    def decode_block(self, token: int, pos: int, n_steps: int) -> list[int]:
+        """Decode up to `n_steps` greedy tokens in one device dispatch."""
+        if pos + n_steps > self.header.seq_len:
+            n_steps = self.header.seq_len - pos
+        if n_steps <= 0:
+            return []
+        arr = jnp.asarray([[token]] * self.batch_size, dtype=jnp.int32)
+        arr = jax.device_put(arr, self._token_sharding)
+        block = self._decode_block_fn(n_steps)
+        out, self.cache = block(self.params, arr, self.cache, jnp.int32(pos))
+        return [int(t) for t in np.asarray(out)[:, 0]]
 
     def _bucket_for(self, n: int, pos: int) -> int:
         """Smallest bucket covering n tokens whose PADDED extent still fits
@@ -173,7 +253,9 @@ class InferenceEngine:
             # p+bucket) — harmless: the causal mask hides them until real
             # tokens overwrite those positions.
             _, self.cache = step(self.params, arr, self.cache, jnp.int32(p))
-            jax.block_until_ready(self.cache["k"])
+            # scalar readback: a real sync (block_until_ready returns early
+            # on the tunneled axon TPU platform)
+            np.asarray(jax.device_get(self.cache["k"][0, 0, 0, 0, 0]))
             total_ms += (time.perf_counter() - t0) * 1000
             p += len(chunk)
         return StepStats(time_ms=total_ms, n_tokens=max(len(tokens) - 1, 0))
@@ -206,25 +288,59 @@ class InferenceEngine:
         max_steps: int,
         on_token=None,
         stop_condition=None,
+        block_size: int = 8,
     ):
         """Prefill + decode loop. Yields nothing; returns (tokens, eval_stats,
         pred_stats). `on_token(token)` fires per generated token and may
         return False to stop (EOS handling lives with the caller, which owns
-        the tokenizer/EosDetector)."""
+        the tokenizer/EosDetector).
+
+        Greedy decoding runs in on-device blocks of `block_size` tokens
+        (one host dispatch per block); a stop mid-block leaves the already-
+        written KV rows beyond the stop as garbage, which is safe — they
+        are causally masked and overwritten by the next prefill at those
+        positions."""
         max_pos = min(self.header.seq_len, max_steps)
         eval_stats = self.prefill(prompt_tokens)
         pos = len(prompt_tokens) - 1
         token = prompt_tokens[-1]
         out_tokens: list[int] = []
         pred_ms = 0.0
-        while pos < max_pos:
-            token, stats = self.decode_step(token, pos)
-            pred_ms += stats.time_ms
-            pos += 1
-            out_tokens.append(token)
-            if on_token is not None and on_token(token) is False:
-                break
-            if stop_condition is not None and stop_condition(token):
-                break
+        greedy = self.temperature == 0.0
+        block = max(1, block_size) if greedy else 1
+        stopped = False
+        while pos < max_pos and not stopped:
+            if block > 1:
+                # run the full block size whenever it fits in the cache
+                # (compiling a one-off program per tail length costs seconds
+                # on this platform); surplus tokens are simply not consumed
+                n = block if pos + block <= self.header.seq_len else (
+                    self.header.seq_len - pos
+                )
+                want = min(n, max_pos - pos)
+                t0 = time.perf_counter()
+                toks = self.decode_block(token, pos, n)[:want]
+                pred_ms += (time.perf_counter() - t0) * 1000
+                if not toks:
+                    break
+                for tk in toks:
+                    pos += 1
+                    out_tokens.append(tk)
+                    if on_token is not None and on_token(tk) is False:
+                        stopped = True
+                        break
+                    if stop_condition is not None and stop_condition(tk):
+                        stopped = True
+                        break
+                token = out_tokens[-1]
+            else:
+                token, stats = self.decode_step(token, pos)
+                pred_ms += stats.time_ms
+                pos += 1
+                out_tokens.append(token)
+                if on_token is not None and on_token(token) is False:
+                    break
+                if stop_condition is not None and stop_condition(token):
+                    break
         return out_tokens, eval_stats, StepStats(pred_ms, len(out_tokens))
 

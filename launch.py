@@ -94,37 +94,60 @@ def confirm(message: str) -> bool:
     return input(f'❓ {message} ("Y" if yes): ').upper() in ("Y", "YES")
 
 
+def _download_part(url: str, part_path: str) -> None:
+    """One part with byte-range resume: restarts continue from the bytes
+    already on disk (`.part` files; the final artifact only appears after
+    every part completed, so a crashed run can never be mistaken for a
+    complete download)."""
+    from urllib.request import Request
+
+    for attempt in range(8):
+        start = os.path.getsize(part_path) if os.path.isfile(part_path) else 0
+        print(f"📄 {url} (attempt: {attempt}, resume at {start >> 20} MB)")
+        try:
+            req = Request(url)
+            if start > 0:
+                req.add_header("Range", f"bytes={start}-")
+            with urlopen(req) as response, open(part_path, "ab" if start else "wb") as f:
+                if start > 0 and response.status != 206:
+                    # server ignored the Range header: restart the part
+                    f.seek(0)
+                    f.truncate()
+                while True:
+                    chunk = response.read(1 << 16)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+                    mb = f.tell() >> 20
+                    if mb % 100 == 0:
+                        print(f"\r📦 {mb} MB", end="", flush=True)
+            print()
+            return
+        except Exception as e:
+            print(f"\n⚠️  {e}; retrying")
+    raise SystemExit(f"download failed: {url}")
+
+
 def download_file(urls: list[str], path: str) -> None:
-    """Multi-part download with retry + resume within a part
-    (reference: launch.py:82-124)."""
+    """Multi-part download; each part resumes independently and the final
+    file is assembled only once all parts are complete."""
     if os.path.isfile(path):
         if not confirm(f"{os.path.basename(path)} already exists, download again?"):
             return
     socket.setdefaulttimeout(30)
-    with open(path, "wb") as f:
-        for url in urls:
-            start = f.tell()
-            ok = False
-            for attempt in range(8):
-                print(f"📄 {url} (attempt: {attempt})")
-                try:
-                    f.seek(start)
-                    with urlopen(url) as response:
-                        while True:
-                            chunk = response.read(1 << 16)
-                            if not chunk:
-                                break
-                            f.write(chunk)
-                            mb = f.tell() // (1024 * 1024)
-                            if mb % 100 == 0:
-                                print(f"\r📦 {mb} MB downloaded", end="", flush=True)
-                    print()
-                    ok = True
-                    break
-                except Exception as e:
-                    print(f"\n⚠️  {e}; retrying")
-            if not ok:
-                raise SystemExit(f"download failed: {url}")
+    part_paths = [f"{path}.part{i}" for i in range(len(urls))]
+    for url, part_path in zip(urls, part_paths):
+        _download_part(url, part_path)
+    with open(path, "wb") as out:
+        for part_path in part_paths:
+            with open(part_path, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 22)
+                    if not chunk:
+                        break
+                    out.write(chunk)
+    for part_path in part_paths:
+        os.remove(part_path)
     print(f"✅ {path}")
 
 

@@ -150,3 +150,40 @@ def test_prefill_bucket_never_pads_past_seq_len(tiny_model):
                          max_seq_len=48, prefill_buckets=(8,))
     out_exact, _, _ = e2.generate(prompt, max_steps=47)
     assert out_bucketed == out_exact
+
+
+def test_quant_weight_format_matches_dense(tiny_model):
+    """weight_format='q40' must reproduce the dense-load greedy tokens
+    exactly (off-TPU the quant path dequantizes at run time — numerically
+    identical to dequant-at-load)."""
+    mp, _ = tiny_model
+    e_dense = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                              weight_format="dense")
+    out_dense, _, _ = e_dense.generate([1, 2, 3, 4], max_steps=12)
+    e_quant = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                              weight_format="q40")
+    out_quant, _, _ = e_quant.generate([1, 2, 3, 4], max_steps=12)
+    assert out_dense == out_quant
+
+
+def test_quant_weight_format_tp(tmp_path):
+    """Quantized weights sharded over a tp=4 mesh reproduce single-chip.
+    Dims must divide by 32*tp (the scale tensors shard their block axis)."""
+    mp = str(tmp_path / "mq.m")
+    cfg = dict(dim=128, hidden_dim=256, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=256, seq_len=64)
+    make_tiny_model(mp, weight_type=FloatType.Q40, cfg=cfg)
+    e1 = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                         weight_format="q40")
+    out1, _, _ = e1.generate([5, 6, 7], max_steps=10)
+    e4 = InferenceEngine(mp, tp=4, dtype=jnp.float32, temperature=0.0,
+                         weight_format="q40")
+    out4, _, _ = e4.generate([5, 6, 7], max_steps=10)
+    assert out1 == out4
+
+
+def test_quant_rejects_non_q40(tmp_path):
+    mp = str(tmp_path / "f32.m")
+    make_tiny_model(mp, weight_type=FloatType.F32)
+    with pytest.raises(ValueError, match="q40"):
+        InferenceEngine(mp, tp=1, dtype=jnp.float32, weight_format="q40")

@@ -1,0 +1,67 @@
+"""Pallas Q40 matmul vs jnp dequant reference (cross-implementation
+equivalence, the reference's nn-cpu-ops-test.cpp:257-277 pattern)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.formats.quants import quantize_q40, q40_to_planar
+from dllama_tpu.ops.quant_matmul import (
+    QuantWeight,
+    dequant,
+    from_planar,
+    qmatmul,
+    qmatmul_2d,
+    qmatmul_ref,
+)
+
+
+def make_qw(n, k, seed=0):
+    """QuantWeight for a logical [out=n, in=k] matmul weight."""
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((n, k)).astype(np.float32) * 0.1
+    raw = quantize_q40(w)
+    q, d = q40_to_planar(raw, n * k)
+    return from_planar(q.reshape(n, k), d.reshape(n, k // 32)), w
+
+
+def test_dequant_matches_codec():
+    qw, w = make_qw(64, 128)
+    dense = np.asarray(dequant(qw, jnp.float32)).T  # device layout is [in, out]
+    # within one Q40 block scale of the original
+    scales = np.abs(w.reshape(-1, 32)).max(axis=1) / 8.0
+    err = np.abs(dense.reshape(-1, 32) - w.reshape(-1, 32))
+    assert (err <= scales[:, None] * 1.01 + 1e-6).all()
+
+
+@pytest.mark.parametrize("m,n,k", [(1, 256, 512), (8, 512, 256), (16, 256, 1024)])
+def test_pallas_kernel_matches_reference(m, n, k):
+    """Interpret-mode kernel vs dequant einsum (bf16 input rounding is the
+    only difference source)."""
+    qw, _ = make_qw(n, k, seed=1)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((m, k)).astype(np.float32))
+    expected = np.asarray(qmatmul_ref(x.astype(jnp.bfloat16).astype(jnp.float32), qw))
+    got = np.asarray(qmatmul_2d(x, qw.q, qw.d, block_n=128, interpret=True))
+    np.testing.assert_allclose(got, expected, rtol=2e-2, atol=2e-2)
+
+
+def test_qmatmul_auto_flatten():
+    qw, _ = make_qw(128, 256, seed=3)
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((2, 3, 256)).astype(np.float32))
+    out = qmatmul(x, qw)
+    assert out.shape == (2, 3, 128)
+    expected = qmatmul_ref(x, qw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=2e-2, atol=2e-2)
+
+
+def test_quantweight_is_pytree():
+    import jax
+
+    qw, _ = make_qw(64, 64)
+    stacked = jax.tree.map(lambda a: jnp.stack([a, a]), qw)
+    assert isinstance(stacked, QuantWeight)
+    assert stacked.q.shape == (2, 64, 64)
+    leaves = jax.tree.leaves(qw)
+    assert len(leaves) == 2
