@@ -32,6 +32,7 @@ from jax import lax
 from ..formats.model_file import HiddenAct, LlmArch, LlmHeader, RopeType
 from ..ops.jnp_ops import apply_rope, gelu, qk_rms_norm, rms_norm, silu
 from ..ops.quant_matmul import QuantWeight, qmatmul_tp
+from ..ops.flash_attention import flash_attention, pick_flash_blocks
 
 Params = Dict[str, Any]
 KvCache = Dict[str, jnp.ndarray]
@@ -61,6 +62,50 @@ def init_kv_cache(
     }
 
 
+def _attention_tp(
+    q: jnp.ndarray,  # [B, T, H, hd]
+    k_cache: jnp.ndarray,  # [B, S, KH, hd]
+    v_cache: jnp.ndarray,  # [B, S, KH, hd]
+    pos: jnp.ndarray,
+    head_dim: int,
+    mesh,
+) -> jnp.ndarray:
+    """Attention dispatch: the Pallas flash kernel on TPU for prefill-sized
+    T (blockwise online softmax, no [T, S] score materialization — the
+    long-context replacement for multiheadAtt_F32), einsum elsewhere and
+    for single-token decode where one [S] row is cheap.
+
+    Heads are the TP axis (reference: sliceMultiHeadAtt), so the kernel
+    runs per-shard under shard_map with no collectives.
+    """
+    b, t = q.shape[0], q.shape[1]
+    use_flash = (
+        jax.default_backend() == "tpu"
+        and t >= 8
+        and pick_flash_blocks(t, k_cache.shape[1]) is not None
+    )
+    if not use_flash:
+        out = _attention(q, k_cache, v_cache, pos, head_dim)
+        return out
+    n_heads = q.shape[2]
+    if mesh is None or mesh.devices.size == 1:
+        out = flash_attention(q, k_cache, v_cache, pos)
+    else:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        spec_q = P("dp", None, "tp", None)
+        spec_kv = P("dp", None, "tp", None)
+        out = shard_map(
+            lambda qq, kk, vv, pp: flash_attention(qq, kk, vv, pp),
+            mesh=mesh,
+            in_specs=(spec_q, spec_kv, spec_kv, P()),
+            out_specs=spec_q,
+            check_vma=False,
+        )(q, k_cache, v_cache, pos)
+    return out.reshape(b, t, n_heads * head_dim)
+
+
 def _attention(
     q: jnp.ndarray,  # [B, T, H, hd]
     k_cache: jnp.ndarray,  # [B, S, KH, hd]
@@ -68,32 +113,14 @@ def _attention(
     pos: jnp.ndarray,  # scalar int32: absolute position of tokens[:, 0]
     head_dim: int,
 ) -> jnp.ndarray:
-    """Causal GQA attention over the full cache with position masking
-    (reference: multiheadAtt_F32, src/nn/nn-cpu-ops.cpp:753-788).
+    """Causal GQA attention over the full cache, flattened to
+    [B, T, H * hd]; math lives in ops/jnp_ops.attention_dense (reference:
+    multiheadAtt_F32, src/nn/nn-cpu-ops.cpp:753-788)."""
+    from ..ops.jnp_ops import attention_dense
 
-    Grouped einsum keeps the kv-head axis explicit (no materialized
-    `repeat`): q is viewed as [B, T, KH, G, hd] where G = nHeads/nKvHeads
-    (the reference's `kvMul` GQA mapping).
-    """
     b, t, n_heads, _ = q.shape
-    s = k_cache.shape[1]
-    kh = k_cache.shape[2]
-    g = n_heads // kh
-
-    qf = q.astype(jnp.float32).reshape(b, t, kh, g, head_dim)
-    kf = k_cache.astype(jnp.float32)
-    vf = v_cache.astype(jnp.float32)
-
-    scores = jnp.einsum("btkgh,bskh->bkgts", qf, kf) / jnp.sqrt(
-        jnp.float32(head_dim)
-    )
-    q_pos = pos + jnp.arange(t, dtype=jnp.int32)  # [T]
-    s_pos = jnp.arange(s, dtype=jnp.int32)  # [S]
-    mask = s_pos[None, :] <= q_pos[:, None]  # [T, S]
-    scores = jnp.where(mask[None, None, None, :, :], scores, _NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bskh->btkgh", probs, vf)
-    return out.reshape(b, t, n_heads * head_dim).astype(q.dtype)
+    out = attention_dense(q, k_cache, v_cache, pos)
+    return out.reshape(b, t, n_heads * head_dim)
 
 
 def _moe_ffn(
@@ -191,7 +218,7 @@ def forward(
             v_cache_l, v.astype(v_cache_l.dtype), pos, axis=1
         )
 
-        z = _attention(q, k_cache_l, v_cache_l, pos, h.head_dim)
+        z = _attention_tp(q, k_cache_l, v_cache_l, pos, h.head_dim, mesh)
         x = x + _mm(z, lp["wo"], "col", mesh).astype(x.dtype)
 
         # -- FFN block (reference: src/llm.cpp:405-557) --

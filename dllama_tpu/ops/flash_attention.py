@@ -1,0 +1,205 @@
+"""Causal GQA flash attention over the positional KV cache (Pallas TPU).
+
+Replaces the reference's multiheadAtt_F32 (src/nn/nn-cpu-ops.cpp:753-788)
+for prefill: the reference materializes a per-head [seqLen] score row per
+query (O(T*S) memory); blockwise online-softmax keeps everything in VMEM
+tiles, which is what makes 100k+ context feasible (SURVEY.md §5 calls this
+out as the biggest idiomatic upgrade over the reference).
+
+Semantics match models/transformer._attention exactly:
+  * queries at absolute positions pos..pos+T-1 attend to cache rows
+    0..q_pos (causal, inclusive);
+  * GQA: q head h reads kv head h // (H // KH);
+  * f32 softmax/accumulation, bf16/f32 inputs.
+
+Kernel layout: grid (B * H, T blocks, S blocks), S innermost so the online
+softmax state (m, l, acc) lives in VMEM scratch across S steps. S blocks
+entirely above the causal diagonal are compute-skipped via pl.when.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def pick_flash_blocks(t: int, s: int) -> tuple[int, int] | None:
+    """(block_t, block_s) that divide the shapes, or None when the flash
+    kernel can't run them (callers then fall back to dense attention).
+    block_t: largest multiple of 8 <= 256 dividing t; block_s: largest
+    multiple of 128 <= 512 dividing s."""
+    bt = next((b for b in range(min(256, t), 0, -8) if t % b == 0), None)
+    bs = next((b for b in range(min(512, s - s % 128), 0, -128) if s % b == 0), None)
+    if not bt or not bs:
+        return None
+    return bt, bs
+
+
+def attention_ref(
+    q: jnp.ndarray,  # [B, T, H, hd]
+    k_cache: jnp.ndarray,  # [B, S, KH, hd]
+    v_cache: jnp.ndarray,  # [B, S, KH, hd]
+    pos: jnp.ndarray,  # scalar int32
+) -> jnp.ndarray:
+    """jnp reference: the canonical masked-softmax math from ops/jnp_ops
+    (same source the model's dense path and ring attention use)."""
+    from .jnp_ops import attention_dense
+
+    return attention_dense(q, k_cache, v_cache, pos)
+
+
+def _flash_kernel(
+    pos_ref,  # SMEM scalar prefetch: [1] int32 absolute start position
+    q_ref,  # [1, bt, hd]
+    k_ref,  # [1, bs, hd]
+    v_ref,  # [1, bs, hd]
+    o_ref,  # [1, bt, hd]
+    m_ref,  # VMEM [bt, 128] running max
+    l_ref,  # VMEM [bt, 128] running denominator
+    acc_ref,  # VMEM [bt, hd] weighted-value accumulator
+    *,
+    block_t: int,
+    block_s: int,
+    n_s: int,
+    scale: float,
+):
+    ti = pl.program_id(1)
+    si = pl.program_id(2)
+    pos = pos_ref[0]
+
+    @pl.when(si == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # absolute positions of this tile's queries and keys
+    q_pos0 = pos + ti * block_t  # first query's absolute position
+    s_start = si * block_s
+
+    # the whole S block is above the causal diagonal for every query in the
+    # T block -> skip (the highest query position is q_pos0 + block_t - 1)
+    @pl.when(s_start <= q_pos0 + block_t - 1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        scores = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # [bt, bs]
+        q_pos = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, (block_t, block_s), 0)
+        s_pos = s_start + jax.lax.broadcasted_iota(jnp.int32, (block_t, block_s), 1)
+        scores = jnp.where(s_pos <= q_pos, scores, _NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [bt, 1]
+        m_cur = jnp.max(scores, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # rescale of previous state
+        p = jnp.exp(scores - m_new)  # [bt, bs]
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(si == n_s - 1)
+    def _emit():
+        # l is 0 only if every key was masked, which cannot happen for a
+        # causal query at position >= 0 (it always sees itself)
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_t", "block_s", "interpret"),
+)
+def flash_attention(
+    q: jnp.ndarray,  # [B, T, H, hd]
+    k_cache: jnp.ndarray,  # [B, S, KH, hd]
+    v_cache: jnp.ndarray,  # [B, S, KH, hd]
+    pos: jnp.ndarray,  # scalar int32
+    block_t: int = 0,
+    block_s: int = 0,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Blockwise causal GQA attention; returns [B, T, H, hd] in q.dtype.
+
+    Default block sizes come from `pick_flash_blocks`, which guarantees
+    divisibility; explicit blocks must divide t/s."""
+    b, t, h, hd = q.shape
+    s, kh = k_cache.shape[1], k_cache.shape[2]
+    g = h // kh
+    if not block_t or not block_s:
+        picked = pick_flash_blocks(t, s)
+        if picked is None:
+            raise ValueError(
+                f"no valid flash blocks for t={t}, s={s}; use dense attention"
+            )
+        auto_t, auto_s = picked
+        block_t = block_t or auto_t
+        block_s = block_s or auto_s
+    assert t % block_t == 0, (t, block_t)
+    assert s % block_s == 0, (s, block_s)
+    n_t = t // block_t
+    n_s = s // block_s
+    scale = 1.0 / (hd**0.5)
+
+    # [B, T, H, hd] -> [B*H, T, hd]; kv gets a broadcast-free gather of the
+    # right kv head per q head via the index map (no repeat materialized)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
+    kt = k_cache.transpose(0, 2, 1, 3).reshape(b * kh, s, hd)
+    vt = v_cache.transpose(0, 2, 1, 3).reshape(b * kh, s, hd)
+
+    pos_arr = jnp.asarray([pos], dtype=jnp.int32).reshape(1)
+
+    grid = (b * h, n_t, n_s)
+
+    # with num_scalar_prefetch=1 the index maps receive the prefetch ref
+    # as a trailing argument
+    def q_map(bh, ti, si, pos_ref):
+        return (bh, ti, 0)
+
+    def kv_map(bh, ti, si, pos_ref):
+        # q row bh = bi * h + hi -> kv row bi * kh + hi // g
+        bi = bh // h
+        hi = bh % h
+        return (bi * kh + hi // g, si, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            block_t=block_t,
+            block_s=block_s,
+            n_s=n_s,
+            scale=scale,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_t, hd), q_map),
+                pl.BlockSpec((1, block_s, hd), kv_map),
+                pl.BlockSpec((1, block_s, hd), kv_map),
+            ],
+            out_specs=pl.BlockSpec((1, block_t, hd), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((block_t, 128), jnp.float32),
+                pltpu.VMEM((block_t, 128), jnp.float32),
+                pltpu.VMEM((block_t, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, hd), q.dtype),
+        interpret=interpret,
+    )(pos_arr, qt, kt, vt)
+    return out.reshape(b, h, t, hd).transpose(0, 2, 1, 3)

@@ -133,3 +133,52 @@ def apply_rope(
         r1 = x0 * s + x1 * c
         out = jnp.concatenate([r0, r1], axis=-1)
     return out.astype(dtype)
+
+
+_NEG_INF = -1e30
+
+
+def attention_stats(
+    q: jnp.ndarray,  # [B, Tq, H, hd]
+    k: jnp.ndarray,  # [B, Ts, KH, hd]
+    v: jnp.ndarray,  # [B, Ts, KH, hd]
+    q_pos0,  # scalar: absolute position of q[:, 0]
+    s_pos0,  # scalar: absolute position of k[:, 0]
+):
+    """Causal GQA attention partial state (unnormalized acc, running max m,
+    denominator l) in f32 — the single source of the reference's
+    multiheadAtt_F32 math (src/nn/nn-cpu-ops.cpp:753-788). Dense attention
+    normalizes it directly; ring attention merges several of these across
+    sequence shards."""
+    b, tq, h, hd = q.shape
+    ts, kh = k.shape[1], k.shape[2]
+    g = h // kh
+    qf = q.astype(jnp.float32).reshape(b, tq, kh, g, hd)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    scores = jnp.einsum("btkgh,bskh->bkgts", qf, kf) / jnp.sqrt(jnp.float32(hd))
+    q_pos = q_pos0 + jnp.arange(tq, dtype=jnp.int32)
+    s_pos = s_pos0 + jnp.arange(ts, dtype=jnp.int32)
+    mask = s_pos[None, :] <= q_pos[:, None]
+    scores = jnp.where(mask[None, None, None, :, :], scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1)  # [b, kh, g, tq]
+    p = jnp.exp(scores - m[..., None])
+    # fully-masked rows (query before every key in this shard) -> zero
+    p = jnp.where(m[..., None] <= _NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bkgts,bskh->bkgth", p, vf)
+    return acc, m, l
+
+
+def attention_dense(
+    q: jnp.ndarray,  # [B, T, H, hd]
+    k_cache: jnp.ndarray,  # [B, S, KH, hd]
+    v_cache: jnp.ndarray,
+    pos,  # scalar: absolute position of q[:, 0]
+) -> jnp.ndarray:
+    """Normalized causal GQA attention over the cache; [B, T, H, hd]."""
+    b, t, h, hd = q.shape
+    acc, m, l = attention_stats(q, k_cache, v_cache, pos, 0)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe[..., None]  # [b, kh, g, tq, hd]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, t, h, hd).astype(q.dtype)

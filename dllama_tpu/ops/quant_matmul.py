@@ -215,7 +215,7 @@ def qmatmul_tp(
     if mesh is None or mesh.devices.size == 1:
         return qmatmul(x, w)
 
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     if role == "row":
@@ -244,5 +244,5 @@ def qmatmul_tp(
         raise ValueError(f"unknown role: {role}")
 
     return shard_map(
-        f, mesh=mesh, in_specs=in_specs, out_specs=out_spec, check_rep=False
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_spec, check_vma=False
     )(x, w.q, w.d)

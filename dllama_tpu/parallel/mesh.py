@@ -65,30 +65,36 @@ def auto_tp(model_path: str, n_devices: int | None = None) -> int:
     return tp
 
 
-def make_mesh(tp: int = 1, dp: int = 1, devices=None) -> Mesh:
-    """Build a (dp, tp) mesh over the available devices.
+def make_mesh(tp: int = 1, dp: int = 1, sp: int = 1, devices=None) -> Mesh:
+    """Build a (dp, sp, tp) mesh over the available devices.
 
-    Uses `jax.experimental.mesh_utils` device ordering so the tp axis maps
-    to physically adjacent chips (fastest ICI hops) on real TPU slices.
+    `sp` is the sequence/context-parallel axis (ring attention); the sp
+    dimension only appears in the mesh when > 1 so existing (dp, tp)
+    PartitionSpecs stay valid. Uses `jax.experimental.mesh_utils` device
+    ordering so the tp axis maps to physically adjacent chips (fastest ICI
+    hops) on real TPU slices.
     """
     if devices is None:
         devices = jax.devices()
-    n_needed = tp * dp
+    n_needed = tp * dp * sp
     if n_needed > len(devices):
         raise ValueError(
-            f"need {n_needed} devices (tp={tp} x dp={dp}), have {len(devices)}"
+            f"need {n_needed} devices (tp={tp} x dp={dp} x sp={sp}), "
+            f"have {len(devices)}"
         )
+    shape = (dp, sp, tp) if sp > 1 else (dp, tp)
+    names = ("dp", "sp", "tp") if sp > 1 else ("dp", "tp")
     try:
         from jax.experimental import mesh_utils
 
         device_array = mesh_utils.create_device_mesh(
-            (dp, tp), devices=devices[:n_needed]
+            shape, devices=devices[:n_needed]
         )
     except Exception:
         import numpy as np
 
-        device_array = np.asarray(devices[:n_needed]).reshape(dp, tp)
-    return Mesh(device_array, axis_names=("dp", "tp"))
+        device_array = np.asarray(devices[:n_needed]).reshape(shape)
+    return Mesh(device_array, axis_names=names)
 
 
 def initialize_multihost(

@@ -1,0 +1,156 @@
+"""Ring attention: causal attention with the KV sequence sharded over chips.
+
+Long-context/sequence parallelism the reference does not have (SURVEY.md §2
+lists SP/CP/ring as absent; §5 marks it the biggest upgrade surface): when a
+context no longer fits one chip's HBM, the KV cache shards along the
+SEQUENCE axis over the `sp` mesh axis and attention runs as a ring:
+
+  * every chip holds one Q shard (its slice of query positions) and one KV
+    shard (its slice of the sequence);
+  * sp steps: each chip computes blockwise attention of its Q shard against
+    the KV shard currently resident, accumulating online-softmax partial
+    state (m, l, acc); after each step the KV shard rotates one hop around
+    the ring via `lax.ppermute` over ICI;
+  * causality falls out of absolute positions: a KV block from a later part
+    of the sequence than a query contributes nothing (fully masked), so the
+    combine is exact, not approximate.
+
+The partial-state combine is the standard log-sum-exp merge:
+    m' = max(m1, m2); l' = e^{m1-m'} l1 + e^{m2-m'} l2
+    acc' = e^{m1-m'} acc1 + e^{m2-m'} acc2
+
+This module is deliberately jnp-level (einsum inside shard_map): correct on
+any backend, and XLA already overlaps the ppermute with compute. Swapping
+the local step for the Pallas flash kernel is a drop-in once it returns
+(m, l, acc) stats.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+from ..ops.jnp_ops import attention_stats as _local_attention_stats_impl
+
+
+def _local_attention_stats(q, k, v, q_pos0, s_pos0):
+    """Shared causal-GQA partial-state math (ops/jnp_ops.attention_stats)."""
+    return _local_attention_stats_impl(q, k, v, q_pos0, s_pos0)
+
+
+def _merge_stats(acc1, m1, l1, acc2, m2, l2):
+    """Log-sum-exp merge of two online-softmax partial states."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    # fully-masked states (m == -inf) contribute nothing
+    a1 = jnp.where(m1 <= _NEG_INF / 2, 0.0, a1)
+    a2 = jnp.where(m2 <= _NEG_INF / 2, 0.0, a2)
+    return (
+        acc1 * a1[..., None] + acc2 * a2[..., None],
+        m,
+        l1 * a1 + l2 * a2,
+    )
+
+
+def ring_attention_local(
+    q: jnp.ndarray,  # [B, Tq, H, hd] this chip's query shard
+    k: jnp.ndarray,  # [B, Ss, KH, hd] this chip's KV shard
+    v: jnp.ndarray,
+    q_pos0: jnp.ndarray,  # absolute position of this chip's first query
+    shard_size: jnp.ndarray,  # sequence length held per chip (Ss)
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """Per-shard ring attention body; call under shard_map with the sequence
+    axis of q/k/v sharded over `axis_name`. Returns [B, Tq, H, hd]."""
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+
+    def step(carry, _):
+        k_cur, v_cur, owner, acc, m, l = carry
+        s_pos0 = owner * shard_size
+        acc2, m2, l2 = _local_attention_stats(q, k_cur, v_cur, q_pos0, s_pos0)
+        acc, m, l = _merge_stats(acc, m, l, acc2, m2, l2)
+        # rotate KV one hop: chip i sends to chip (i+1) % sp, so the shard
+        # owned by (idx - step - 1) arrives next
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        owner = (owner - 1) % sp
+        return (k_nxt, v_nxt, owner, acc, m, l), None
+
+    b, tq, h, hd = q.shape
+    kh = k.shape[2]
+    g = h // kh
+    acc0 = jnp.zeros((b, kh, g, tq, hd), jnp.float32)
+    m0 = jnp.full((b, kh, g, tq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, kh, g, tq), jnp.float32)
+
+    # sp-1 compute+rotate steps, then one final compute — the last shard's
+    # rotation would be discarded, so don't pay that ICI hop
+    carry = (k, v, idx, acc0, m0, l0)
+    if sp > 1:
+        carry, _ = lax.scan(step, carry, None, length=sp - 1)
+    k_last, v_last, owner, acc, m, l = carry
+    acc2, m2, l2 = _local_attention_stats(
+        q, k_last, v_last, q_pos0, owner * shard_size
+    )
+    acc, m, l = _merge_stats(acc, m, l, acc2, m2, l2)
+
+    # normalize; rows with no visible keys (can't happen for causal pos>=0
+    # queries, but keep the guard) -> 0
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe[..., None]  # [b, kh, g, tq, hd]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(b, tq, h, hd)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, T, H, hd] global queries
+    k: jnp.ndarray,  # [B, S, KH, hd] global keys (S = T for self-attention)
+    v: jnp.ndarray,
+    mesh,
+    q_pos0: int | jnp.ndarray = 0,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """Driver: shards the sequence axis of q/k/v over `axis_name`, runs the
+    ring, returns globally-assembled [B, T, H, hd].
+
+    Requires T % sp == 0 and S % sp == 0. Head axes stay whole here; combine
+    with the tp axis by nesting specs when both are in play.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    sp = mesh.shape[axis_name]
+    b, t, h, hd = q.shape
+    s = k.shape[1]
+    assert t % sp == 0 and s % sp == 0, (t, s, sp)
+    shard_size = s // sp
+    tq = t // sp
+
+    def body(qq, kk, vv):
+        idx = lax.axis_index(axis_name)
+        return ring_attention_local(
+            qq,
+            kk,
+            vv,
+            q_pos0=q_pos0 + idx * tq,
+            shard_size=shard_size,
+            axis_name=axis_name,
+        )
+
+    spec = P(None, axis_name, None, None)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

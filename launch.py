@@ -96,9 +96,9 @@ def confirm(message: str) -> bool:
 
 def _download_part(url: str, part_path: str) -> None:
     """One part with byte-range resume: restarts continue from the bytes
-    already on disk (`.part` files; the final artifact only appears after
-    every part completed, so a crashed run can never be mistaken for a
-    complete download)."""
+    already on disk. A part that is already complete is detected by the
+    server's 416 Range-Not-Satisfiable answer and skipped."""
+    import urllib.error
     from urllib.request import Request
 
     for attempt in range(8):
@@ -108,7 +108,14 @@ def _download_part(url: str, part_path: str) -> None:
             req = Request(url)
             if start > 0:
                 req.add_header("Range", f"bytes={start}-")
-            with urlopen(req) as response, open(part_path, "ab" if start else "wb") as f:
+            try:
+                response = urlopen(req)
+            except urllib.error.HTTPError as e:
+                if e.code == 416 and start > 0:
+                    print("   part already complete")
+                    return
+                raise
+            with response, open(part_path, "ab" if start else "wb") as f:
                 if start > 0 and response.status != 206:
                     # server ignored the Range header: restart the part
                     f.seek(0)
@@ -129,8 +136,9 @@ def _download_part(url: str, part_path: str) -> None:
 
 
 def download_file(urls: list[str], path: str) -> None:
-    """Multi-part download; each part resumes independently and the final
-    file is assembled only once all parts are complete."""
+    """Multi-part download; each part resumes independently. Assembly
+    renames part 0 and appends+deletes the rest one by one, so peak disk
+    use stays ~1x the artifact size."""
     if os.path.isfile(path):
         if not confirm(f"{os.path.basename(path)} already exists, download again?"):
             return
@@ -138,16 +146,16 @@ def download_file(urls: list[str], path: str) -> None:
     part_paths = [f"{path}.part{i}" for i in range(len(urls))]
     for url, part_path in zip(urls, part_paths):
         _download_part(url, part_path)
-    with open(path, "wb") as out:
-        for part_path in part_paths:
+    os.replace(part_paths[0], path)
+    with open(path, "ab") as out:
+        for part_path in part_paths[1:]:
             with open(part_path, "rb") as f:
                 while True:
                     chunk = f.read(1 << 22)
                     if not chunk:
                         break
                     out.write(chunk)
-    for part_path in part_paths:
-        os.remove(part_path)
+            os.remove(part_path)
     print(f"✅ {path}")
 
 
