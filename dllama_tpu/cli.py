@@ -45,6 +45,8 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--gpu-segments", default=None)
     p.add_argument("--weight-format", default="auto", choices=["auto", "q40", "dense"],
                    help="q40 keeps weights block-quantized on device (Pallas kernel)")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace of the run to DIR")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -124,12 +126,18 @@ def load_engine(args):
         print(f"💡 nActiveExperts: {h.n_active_experts}")
     print(f"💡 SeqLen: {h.seq_len}")
     print(f"💡 Tp: {tp} chip(s) [{jax.default_backend()}]")
+    print(f"💡 WeightFormat: {engine.weight_format}")
+    from .utils.telemetry import memory_report
+
+    memory_report(engine.params, engine.cache, n_devices=tp).print()
     tok.print_header()
     return engine, tok
 
 
 def run_inference(args) -> None:
     """(reference: dllama.cpp:13-116)"""
+    from .utils.telemetry import profile
+
     engine, tok = load_engine(args)
     if args.prompt is None:
         raise SystemExit("Prompt is required")
@@ -139,29 +147,45 @@ def run_inference(args) -> None:
     if len(tokens) > engine.header.seq_len:
         raise SystemExit("The number of prompt tokens is greater than the sequence length")
 
+    # estimated ICI collective traffic fills the reference's Sent/Recv
+    # columns (socket bytes there; deterministic from the sharding layout
+    # here). The logits all-gather happens once per forward, the per-layer
+    # all-reduces once per token.
+    from .utils.telemetry import ici_traffic_per_token as _ici
+
+    per_tok_bytes = _ici(engine.header, engine.tp, include_logits=False)
+    logits_bytes = _ici(engine.header, engine.tp) - per_tok_bytes
+
     print(args.prompt)
-    eval_stats = engine.prefill(tokens)
-    print(
-        f"🔷️ Eval{eval_stats.time_ms:5.0f} ms Sync    0 ms | "
-        f"Sent     0 kB Recv     0 kB | ({eval_stats.n_tokens} tokens)"
-    )
-    tok.reset_decoder()
-    pos = len(tokens) - 1
-    token = tokens[-1]
-    max_pos = min(engine.header.seq_len, args.steps)
-    pred_ms = 0.0
-    n_pred = 0
-    while pos < max_pos:
-        token, stats = engine.decode_step(token, pos)
-        pos += 1
-        pred_ms += stats.time_ms
-        n_pred += 1
-        piece = tok.decode(token)
+    with profile(args.profile):
+        eval_stats = engine.prefill(tokens)
+        eval_kb = (
+            per_tok_bytes * max(eval_stats.n_tokens, 1) + logits_bytes
+        ) // 1024
         print(
-            f"🔶 Pred{stats.time_ms:5.0f} ms Sync    0 ms | "
-            f"Sent     0 kB Recv     0 kB | {piece if piece is not None else '~'}"
+            f"🔷️ Eval{eval_stats.time_ms:5.0f} ms Sync    0 ms | "
+            f"Sent{eval_kb:6d} kB Recv{eval_kb:6d} kB | "
+            f"({eval_stats.n_tokens} tokens)"
         )
-        sys.stdout.flush()
+        tok.reset_decoder()
+        pos = len(tokens) - 1
+        token = tokens[-1]
+        max_pos = min(engine.header.seq_len, args.steps)
+        pred_ms = 0.0
+        n_pred = 0
+        while pos < max_pos:
+            token, stats = engine.decode_step(token, pos)
+            pos += 1
+            pred_ms += stats.time_ms
+            n_pred += 1
+            piece = tok.decode(token)
+            step_kb = (per_tok_bytes + logits_bytes) // 1024
+            print(
+                f"🔶 Pred{stats.time_ms:5.0f} ms Sync    0 ms | "
+                f"Sent{step_kb:6d} kB Recv{step_kb:6d} kB | "
+                f"{piece if piece is not None else chr(126)}"
+            )
+            sys.stdout.flush()
 
     n_eval = max(len(tokens) - 1, 1)
     print()

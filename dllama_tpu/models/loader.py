@@ -23,6 +23,7 @@ from ..formats.model_file import LlmArch, LlmHeader, ModelReader
 from ..formats.quants import FloatType
 from ..ops.jnp_ops import rope_cache
 from ..ops.quant_matmul import QuantWeight, planar_to_device_layout
+from ..utils import native
 from .transformer import Params
 
 # Placement hook: receives (name, np array) and returns the device array.
@@ -62,8 +63,23 @@ def load_params(
         )
 
     def w(name: str, transpose: bool = True) -> np.ndarray:
+        spec = reader.by_name[name]
+        if (
+            transpose
+            and spec.float_type == FloatType.Q40
+            and len(spec.shape) == 2
+        ):
+            # multithreaded C++ dequant straight into the transposed layout
+            out_dim, in_dim = spec.shape
+            a = native.q40_dequant_transposed(reader.raw(name), out_dim, in_dim)
+            if a is not None:
+                return a
         a = reader.dense_f32(name)
         if transpose:
+            if a.ndim == 2 and a.size >= 1 << 20:
+                t = native.f32_transpose(a)
+                if t is not None:
+                    return t
             a = np.ascontiguousarray(a.T)  # file is (out, in) -> we want (in, out)
         return a
 
@@ -71,12 +87,21 @@ def load_params(
         return np.stack([fn(l) for l in range(h.n_layers)])
 
     def qw(tag: str, fn: Callable[[int], str]):
-        """Stacked QuantWeight for a per-layer matmul tensor."""
+        """Stacked QuantWeight for a per-layer matmul tensor (native C++
+        unpack when built — one multithreaded pass straight into the
+        device layout; numpy fallback otherwise)."""
         qs, ds = [], []
         for l in range(h.n_layers):
-            q, d = planar_to_device_layout(*reader.planar_q40(fn(l)))
-            qs.append(q)
-            ds.append(d)
+            name = fn(l)
+            spec = reader.by_name[name]
+            out_dim, in_dim = spec.shape
+            unpacked = native.q40_unpack_transposed(
+                reader.raw(name), out_dim, in_dim
+            )
+            if unpacked is None:
+                unpacked = planar_to_device_layout(*reader.planar_q40(name))
+            qs.append(unpacked[0])
+            ds.append(unpacked[1])
         return QuantWeight(put(tag, np.stack(qs)), put(tag, np.stack(ds)))
 
     layers: dict[str, jnp.ndarray] = {}
@@ -129,8 +154,13 @@ def load_params(
 
     cos, sin = rope_cache(h)
     if quantize:
-        q, d = planar_to_device_layout(*reader.planar_q40("wcls"))
-        wcls = QuantWeight(put("wcls", q), put("wcls", d))
+        spec = reader.by_name["wcls"]
+        unpacked = native.q40_unpack_transposed(
+            reader.raw("wcls"), spec.shape[0], spec.shape[1]
+        )
+        if unpacked is None:
+            unpacked = planar_to_device_layout(*reader.planar_q40("wcls"))
+        wcls = QuantWeight(put("wcls", unpacked[0]), put("wcls", unpacked[1]))
     else:
         wcls = put("wcls", w("wcls").astype(dtype))
     params: Params = {

@@ -1,0 +1,139 @@
+"""ctypes bindings for the native (C++) data-loader kernels.
+
+The multithreaded C++ path (native/dllama_native.cpp) unpacks Q40 blocks
+straight into the transposed device layout in one pass; the numpy fallback
+keeps everything working when the library isn't built (`make -C native`).
+Auto-builds on first use when a toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libdllama_native.so"))
+
+_lib = None
+_lib_tried = False
+
+
+def _threads() -> int:
+    return max(1, min(os.cpu_count() or 1, 16))
+
+
+def load_library(auto_build: bool = True):
+    """Load (building if needed) the native library; None when unavailable."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.isfile(_LIB_PATH) and auto_build:
+        try:
+            import fcntl
+
+            # serialize concurrent first-use builds (pytest-xdist, multi-
+            # process launches): one builder, others wait on the lock
+            lock_path = _LIB_PATH + ".lock"
+            with open(lock_path, "w") as lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                if not os.path.isfile(_LIB_PATH):
+                    subprocess.run(
+                        ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                        capture_output=True,
+                        timeout=120,
+                        check=True,
+                    )
+        except Exception:
+            return None
+    if not os.path.isfile(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        u8 = ctypes.POINTER(ctypes.c_uint8)
+        i8 = ctypes.POINTER(ctypes.c_int8)
+        f32 = ctypes.POINTER(ctypes.c_float)
+        i64 = ctypes.c_int64
+        lib.q40_unpack_transposed.argtypes = [u8, i64, i64, i8, f32, ctypes.c_int]
+        lib.q40_dequant_transposed.argtypes = [u8, i64, i64, f32, ctypes.c_int]
+        lib.q40_dequant.argtypes = [u8, i64, i64, f32, ctypes.c_int]
+        lib.f32_transpose.argtypes = [f32, i64, i64, f32, ctypes.c_int]
+        lib.dllama_native_version.restype = ctypes.c_int
+        if lib.dllama_native_version() != 1:  # not assert: survives python -O
+            raise RuntimeError("native library ABI version mismatch; run make clean")
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def _u8ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def q40_unpack_transposed(
+    raw: np.ndarray, rows: int, cols: int
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Packed Q40 bytes -> (q int8 [cols, rows], d f32 [cols//32, rows]),
+    i.e. directly in quant_matmul's device layout. None if no native lib."""
+    lib = load_library()
+    if lib is None:
+        return None
+    raw = np.ascontiguousarray(np.frombuffer(raw, dtype=np.uint8))
+    q = np.empty((cols, rows), dtype=np.int8)
+    d = np.empty((cols // 32, rows), dtype=np.float32)
+    lib.q40_unpack_transposed(
+        _u8ptr(raw),
+        rows,
+        cols,
+        q.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        d.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        _threads(),
+    )
+    return q, d
+
+
+def q40_dequant_transposed(raw: np.ndarray, rows: int, cols: int) -> np.ndarray | None:
+    """Packed Q40 bytes ([rows, cols] logical) -> dense f32 [cols, rows]."""
+    lib = load_library()
+    if lib is None:
+        return None
+    raw = np.ascontiguousarray(np.frombuffer(raw, dtype=np.uint8))
+    out = np.empty((cols, rows), dtype=np.float32)
+    lib.q40_dequant_transposed(
+        _u8ptr(raw), rows, cols,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), _threads(),
+    )
+    return out
+
+
+def f32_transpose(arr: np.ndarray) -> np.ndarray | None:
+    """Tiled multithreaded [rows, cols] -> [cols, rows] transpose."""
+    lib = load_library()
+    if lib is None:
+        return None
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    rows, cols = arr.shape
+    out = np.empty((cols, rows), dtype=np.float32)
+    lib.f32_transpose(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), rows, cols,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), _threads(),
+    )
+    return out
+
+
+def q40_dequant(raw: np.ndarray, rows: int, cols: int) -> np.ndarray | None:
+    """Packed Q40 bytes -> dense f32 [rows, cols] (file order)."""
+    lib = load_library()
+    if lib is None:
+        return None
+    raw = np.ascontiguousarray(np.frombuffer(raw, dtype=np.uint8))
+    out = np.empty((rows, cols), dtype=np.float32)
+    lib.q40_dequant(
+        _u8ptr(raw), rows, cols,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), _threads(),
+    )
+    return out

@@ -1,0 +1,143 @@
+"""Observability: memory reports, ICI traffic estimates, profiler hooks.
+
+The reference's observability surface (SURVEY.md §5) is: required-memory
+printout at startup (nn-core.cpp:175-189), per-token Eval/Sync ms +
+Sent/Recv kB (dllama.cpp:59-66), and compile-time debug dumps. The TPU
+equivalents here:
+
+  * `memory_report` — exact per-leaf accounting of params + KV cache bytes,
+    total and per-chip (what the reference's `printRequiredMemory` did);
+  * `ici_traffic_per_token` — analytic bytes/token of tensor-parallel
+    collectives (the Sent/Recv column: ICI traffic isn't countable from the
+    host the way the reference counts socket bytes, but it is exactly
+    determined by the sharding layout);
+  * `profile` — context manager around jax.profiler for kernel-level traces
+    (the deep-dive tool the reference lacked entirely).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from ..formats.model_file import LlmHeader
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit, div in (("GB", 1024**3), ("MB", 1024**2), ("kB", 1024)):
+        if n >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"
+
+
+def _leaf_bytes(tree) -> int:
+    return sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves(tree)
+        if hasattr(x, "dtype")
+    )
+
+
+@dataclass
+class MemoryReport:
+    params_bytes: int
+    cache_bytes: int
+    n_devices: int
+    replicated_bytes: int = 0
+
+    @property
+    def total_bytes(self) -> int:
+        return self.params_bytes + self.cache_bytes
+
+    @property
+    def per_device_bytes(self) -> int:
+        # replicated leaves (embed table, norms, rope) live whole on every
+        # chip; only the sharded remainder divides by the mesh size
+        n = max(self.n_devices, 1)
+        sharded = self.total_bytes - self.replicated_bytes
+        return self.replicated_bytes + sharded // n
+
+    def print(self) -> None:
+        print(f"💾 Params: {_fmt_bytes(self.params_bytes)}")
+        print(f"💾 KV cache: {_fmt_bytes(self.cache_bytes)}")
+        print(
+            f"💾 Total: {_fmt_bytes(self.total_bytes)} "
+            f"(~{_fmt_bytes(self.per_device_bytes)}/chip over "
+            f"{self.n_devices} chip(s))"
+        )
+
+
+_REPLICATED_KEYS = {
+    "embed", "final_norm", "rope_cos", "rope_sin",
+    "att_norm", "ffn_norm", "q_norm", "k_norm", "moe_gate",
+}
+
+
+def memory_report(params, cache, n_devices: int = 1) -> MemoryReport:
+    """Accounting of the loaded model (reference: printRequiredMemory).
+    Replication follows parallel/sharding.param_spec_tree: the embed table,
+    norms, gates and rope tables are whole on every chip."""
+    replicated = 0
+    for key in _REPLICATED_KEYS:
+        for scope in (params, params.get("layers", {})):
+            leaf = scope.get(key) if hasattr(scope, "get") else None
+            if leaf is not None:
+                replicated += _leaf_bytes(leaf)
+    return MemoryReport(
+        params_bytes=_leaf_bytes(params),
+        cache_bytes=_leaf_bytes(cache),
+        n_devices=n_devices,
+        replicated_bytes=replicated,
+    )
+
+
+def ici_traffic_per_token(
+    h: LlmHeader, tp: int, activation_bytes: int = 2, include_logits: bool = True
+) -> int:
+    """Analytic per-decoded-token ICI bytes per chip for the TP layout.
+
+    Two all-reduces of a [dim] activation per layer (after attention's
+    col-split wo and the FFN's col-split w2 — where the reference ran
+    SYNC_NODE_SLICES + MERGE_ADD, llm.cpp:403,554) plus the logits
+    all-gather (vocab/tp per chip receives the rest). Ring all-reduce moves
+    2*(tp-1)/tp of the payload per chip.
+    """
+    if tp <= 1:
+        return 0
+    ring = 2 * (tp - 1) / tp
+    per_layer = 2 * h.dim * activation_bytes * ring
+    logits = h.vocab_size * 4 * (tp - 1) / tp if include_logits else 0.0
+    return int(h.n_layers * per_layer + logits)
+
+
+@contextlib.contextmanager
+def profile(log_dir: str | None):
+    """jax.profiler trace scope; no-op when log_dir is falsy."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        print(f"🔬 Profile trace written to {log_dir}")
+
+
+class Counter:
+    """Tiny run-length metric accumulator for the serving surface."""
+
+    def __init__(self):
+        self.n = 0
+        self.total_ms = 0.0
+
+    def add(self, ms: float, n: int = 1) -> None:
+        self.n += n
+        self.total_ms += ms
+
+    @property
+    def rate(self) -> float:
+        return self.n * 1000.0 / self.total_ms if self.total_ms > 0 else 0.0

@@ -1,0 +1,51 @@
+// Minimal API client for the dllama-tpu OpenAI-compatible server
+// (port of the reference's examples/chat-api-client.js).
+//
+// Start the server:
+//   python -m dllama_tpu.runtime.api_server --model m.m --tokenizer t.t --port 9990
+// Then:  node chat-api-client.js
+
+const HOST = process.env.DLLAMA_HOST || 'localhost';
+const PORT = process.env.DLLAMA_PORT || 9990;
+
+async function chat(messages, stream = false) {
+    const response = await fetch(`http://${HOST}:${PORT}/v1/chat/completions`, {
+        method: 'POST',
+        headers: { 'Content-Type': 'application/json' },
+        body: JSON.stringify({
+            messages,
+            temperature: 0.7,
+            max_tokens: 128,
+            stream,
+        }),
+    });
+    if (!stream) {
+        const data = await response.json();
+        return data.choices[0].message.content;
+    }
+    const reader = response.body.getReader();
+    const decoder = new TextDecoder();
+    let text = '';
+    for (;;) {
+        const { done, value } = await reader.read();
+        if (done) break;
+        for (const line of decoder.decode(value).split('\r\n')) {
+            if (!line.startsWith('data: ') || line === 'data: [DONE]') continue;
+            const chunk = JSON.parse(line.slice(6));
+            const delta = chunk.choices[0].delta;
+            if (delta && delta.content) {
+                process.stdout.write(delta.content);
+                text += delta.content;
+            }
+        }
+    }
+    process.stdout.write('\n');
+    return text;
+}
+
+(async () => {
+    console.log('non-streaming:');
+    console.log(await chat([{ role: 'user', content: 'What is a TPU?' }]));
+    console.log('streaming:');
+    await chat([{ role: 'user', content: 'Count to five.' }], true);
+})();

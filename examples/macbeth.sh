@@ -1,0 +1,33 @@
+#!/bin/bash
+# Long-generation determinism check — the TPU port of the reference's
+# examples/macbeth.sh: greedy-decode a long continuation twice and require
+# byte-identical output (catches nondeterministic kernels/collectives).
+#
+# Usage: ./macbeth.sh <model.m> <tokenizer.t> [steps]
+
+set -e
+MODEL=${1:?usage: macbeth.sh <model.m> <tokenizer.t> [steps]}
+TOK=${2:?tokenizer path required}
+STEPS=${3:-128}
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+
+PROMPT="Tomorrow, and tomorrow, and tomorrow, Creeps in this petty pace from day to day"
+
+run() {
+    python -m dllama_tpu inference \
+        --model "$MODEL" --tokenizer "$TOK" --tp "${TP:-1}" \
+        --prompt "$PROMPT" --steps "$STEPS" --temperature 0.0 \
+        2>/dev/null | grep '^🔶' | sed 's/.*| //'
+}
+
+A=$(run)
+B=$(run)
+if [ "$A" = "$B" ]; then
+    echo "✅ deterministic over $STEPS steps"
+else
+    echo "❌ outputs differ between runs"
+    diff <(echo "$A") <(echo "$B") | head
+    exit 1
+fi
