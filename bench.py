@@ -20,6 +20,10 @@ import time
 from functools import partial
 
 import jax
+from dllama_tpu.parallel.mesh import reassert_platform
+
+reassert_platform()
+
 import jax.numpy as jnp
 import numpy as np
 

@@ -298,14 +298,9 @@ def run_perplexity(args) -> None:
 
 
 def main(argv=None) -> None:
-    import os
+    from .parallel.mesh import reassert_platform
 
-    # This environment's TPU platform plugin wins over the JAX_PLATFORMS env
-    # var; re-assert the user's choice through the config API so
-    # `JAX_PLATFORMS=cpu` (e.g. the 8-virtual-device CPU harness) works.
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
+    reassert_platform()
     args = _build_parser().parse_args(argv)
     if args.mode == "worker":
         raise SystemExit(

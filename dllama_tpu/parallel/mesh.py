@@ -23,6 +23,21 @@ from jax.sharding import Mesh
 from ..formats.model_file import LlmHeader
 
 
+def reassert_platform() -> None:
+    """Re-assert the JAX_PLATFORMS env choice through the config API.
+
+    This environment's TPU platform plugin wins over the env var in some
+    import orders, and with the tunnel down the plugin probe can hang —
+    every entry point that honors JAX_PLATFORMS must call this before
+    touching devices. Raises if the requested platform can't be set (a
+    silent fallback would benchmark/run on the wrong backend)."""
+    import os
+
+    requested = os.environ.get("JAX_PLATFORMS")
+    if requested:
+        jax.config.update("jax_platforms", requested)
+
+
 def validate_tp(h: LlmHeader, tp: int) -> None:
     """Mirror the reference's shardability constraints (src/app.cpp:236-240
     requires nNodes ≤ nKvHeads and 2^n nodes; the dimension divisibility

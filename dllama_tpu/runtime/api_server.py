@@ -411,10 +411,9 @@ def main(argv=None) -> None:
     add_engine_args(parser)
     args = parser.parse_args(argv)
 
-    # This environment's TPU platform plugin wins over the JAX_PLATFORMS env
-    # var; re-assert the user's choice through the config API.
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from ..parallel.mesh import reassert_platform
+
+    reassert_platform()
 
     engine, tok = load_engine(args)
     server = serve(

@@ -111,3 +111,26 @@ def test_batch_axis(tmp_path):
     np.testing.assert_allclose(
         np.asarray(logits)[0], np.asarray(logits)[1], rtol=1e-6, atol=1e-6
     )
+
+
+def test_moe_gather_decode_matches_dense_routing(tmp_path):
+    """The decode-path gather MoE (active experts only) must reproduce the
+    dense-routing MoE logits exactly: decode T=1 steps vs full prefill."""
+    h, params, _ = build(tmp_path, arch=LlmArch.QWEN3_MOE)
+    tokens = jnp.asarray([TOKENS], dtype=jnp.int32)
+    cache = init_kv_cache(h, batch_size=1)
+    # prefill uses dense routing (T=8 > 4)
+    full_logits, _ = forward(params, h, tokens, jnp.int32(0), cache)
+
+    # step-by-step decode with the gather path forced on (T=1)
+    cache = init_kv_cache(h, batch_size=1)
+    step_logits = []
+    for i, t in enumerate(TOKENS):
+        lg, cache = forward(
+            params, h, jnp.asarray([[t]], dtype=jnp.int32), jnp.int32(i), cache,
+            moe_gather_max_tokens=4,
+        )
+        step_logits.append(np.asarray(lg)[0, 0])
+    np.testing.assert_allclose(
+        np.asarray(full_logits)[0], np.stack(step_logits), rtol=1e-4, atol=1e-4
+    )
