@@ -137,7 +137,7 @@ class ApiState:
         engine.sampler.set_temp(params.temperature)
         engine.sampler.set_topp(params.top_p)
         if params.seed is not None:
-            engine.sampler.set_seed(params.seed)
+            engine.set_seed(params.seed)
 
         delta_prompt, start_pos = self.naive_cache.resolve_delta_prompt(
             params.messages
@@ -415,15 +415,35 @@ def main(argv=None) -> None:
 
     reassert_platform()
 
-    engine, tok = load_engine(args)
-    server = serve(
-        engine,
-        tok,
-        host=args.host,
-        port=args.port,
-        model_name=os.path.basename(args.model),
-    )
-    server.serve_forever()
+    # crash-and-retry outer loop (reference: dllama-api retries whole app
+    # init every 3 s, dllama-api.cpp:616-628). Transient failures
+    # (accelerator/tunnel/runtime errors) retry; permanent configuration
+    # errors (missing files, invalid settings) exit, and the dead engine is
+    # dropped before a reload so device memory isn't pinned twice.
+    import gc
+
+    while True:
+        engine = None
+        try:
+            engine, tok = load_engine(args)
+            server = serve(
+                engine,
+                tok,
+                host=args.host,
+                port=args.port,
+                model_name=os.path.basename(args.model),
+            )
+            server.serve_forever()
+            return
+        except KeyboardInterrupt:
+            return
+        except (SystemExit, FileNotFoundError, ValueError):
+            raise
+        except Exception as e:
+            print(f"⚠️  {e}; retrying in 3s...")
+            del engine
+            gc.collect()
+            time.sleep(3)
 
 
 if __name__ == "__main__":

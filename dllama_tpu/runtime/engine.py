@@ -36,6 +36,30 @@ from .sampler import Sampler
 DEFAULT_PREFILL_BUCKETS = (1, 8, 32, 128, 512)
 
 
+def _sample_on_device(logits, temperature, topp, key):
+    """Temperature + top-p sampling on device, [B, V] f32 -> [B] int32.
+
+    Same selection rule as the host sampler (keep the smallest prefix of
+    descending probs whose cumulative mass exceeds topp, including the
+    crossing token — reference: sample_topp, tokenizer.cpp:426-467) but
+    driven by the JAX PRNG instead of xorshift: on-device sampling keeps
+    the decode loop free of per-token host round trips. Seeded runs are
+    reproducible, just under a different (documented) RNG than the
+    reference.
+    """
+    probs = jax.nn.softmax(logits / temperature, axis=-1)
+    sorted_probs = jnp.sort(probs, axis=-1)[..., ::-1]
+    csum = jnp.cumsum(sorted_probs, axis=-1)
+    cross = jnp.argmax(csum > topp, axis=-1)
+    thresh = jnp.take_along_axis(sorted_probs, cross[..., None], axis=-1)
+    topp_valid = jnp.logical_and(topp > 0.0, topp < 1.0)
+    masked = jnp.where(probs >= thresh, probs, 0.0)
+    probs = jnp.where(topp_valid, masked, probs)
+    return jax.random.categorical(
+        key, jnp.log(probs + 1e-30), axis=-1
+    ).astype(jnp.int32)
+
+
 @dataclasses.dataclass
 class StepStats:
     """Per-forward timing surface (reference: dllama.cpp:59-66,88-95)."""
@@ -117,6 +141,8 @@ class InferenceEngine:
         self.cache = self._fresh_cache()
         self._token_sharding = NamedSharding(self.mesh, P("dp", None))
         self._compiled = {}
+        self._base_key = jax.random.PRNGKey(seed)
+        self._rng_calls = 0
 
     # -- cache ---------------------------------------------------------------
 
@@ -129,6 +155,13 @@ class InferenceEngine:
     def reset(self) -> None:
         """Drop KV state (new conversation)."""
         self.cache = self._fresh_cache()
+
+    def set_seed(self, seed: int) -> None:
+        """Reseed BOTH sampling paths (host xorshift sampler and the
+        on-device PRNG used by blocked decode)."""
+        self.sampler.set_seed(seed)
+        self._base_key = jax.random.PRNGKey(seed)
+        self._rng_calls = 0
 
     # -- compiled steps ------------------------------------------------------
 
@@ -162,28 +195,42 @@ class InferenceEngine:
         self._compiled[key] = step
         return step
 
-    def _decode_block_fn(self, n_steps: int):
-        """Jitted on-device greedy decode of `n_steps` tokens: the sample ->
+    def _decode_block_fn(self, n_steps: int, greedy: bool):
+        """Jitted on-device decode of `n_steps` tokens: the sample ->
         feed-back loop runs under `lax.fori_loop`, so the host pays one
         dispatch per block instead of one per token (host->device dispatch
         costs ~10ms/step when the chip sits behind a tunnel; this is the
-        lax.fori_loop multi-step plan from SURVEY.md §7 hard parts)."""
-        key = ("block", n_steps)
+        lax.fori_loop multi-step plan from SURVEY.md §7 hard parts).
+        Sampling (temperature/top-p) runs on device too; temp/topp are
+        traced so changing them does not recompile."""
+        key = ("block", n_steps, greedy)
         if key in self._compiled:
             return self._compiled[key]
         h = self.header
         mesh = self.mesh
+        precision = self._precision
 
         @partial(jax.jit, donate_argnums=(2,))
-        def block(params, token, cache, pos):
+        def block(params, token, cache, pos, rng, temperature, topp):
             def body(i, carry):
                 tok, cache, out = carry
-                logits, cache = forward(params, h, tok, pos + i, cache, mesh=mesh)
-                nxt = (
-                    jnp.argmax(logits[:, -1, :], axis=-1)
-                    .astype(jnp.int32)
-                    .reshape(-1, 1)
+                ctx = (
+                    jax.default_matmul_precision(precision)
+                    if precision
+                    else contextlib.nullcontext()
                 )
+                with ctx:
+                    logits, cache = forward(
+                        params, h, tok, pos + i, cache, mesh=mesh
+                    )
+                last = logits[:, -1, :]
+                if greedy:
+                    nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                else:
+                    nxt = _sample_on_device(
+                        last, temperature, topp, jax.random.fold_in(rng, i)
+                    )
+                nxt = nxt.reshape(-1, 1)
                 out = lax.dynamic_update_index_in_dim(out, nxt[:, 0], i, axis=0)
                 return nxt, cache, out
 
@@ -197,15 +244,31 @@ class InferenceEngine:
         return block
 
     def decode_block(self, token: int, pos: int, n_steps: int) -> list[int]:
-        """Decode up to `n_steps` greedy tokens in one device dispatch."""
+        """Decode up to `n_steps` tokens in one device dispatch (greedy when
+        temperature == 0, on-device temperature/top-p sampling otherwise)."""
         if pos + n_steps > self.header.seq_len:
             n_steps = self.header.seq_len - pos
         if n_steps <= 0:
             return []
         arr = jnp.asarray([[token]] * self.batch_size, dtype=jnp.int32)
         arr = jax.device_put(arr, self._token_sharding)
-        block = self._decode_block_fn(n_steps)
-        out, self.cache = block(self.params, arr, self.cache, jnp.int32(pos))
+        greedy = self.temperature == 0.0
+        block = self._decode_block_fn(n_steps, greedy)
+        # fold in a call counter so successive generations differ (the
+        # reference's xorshift state advances across calls the same way)
+        self._rng_calls += 1
+        rng = jax.random.fold_in(
+            jax.random.fold_in(self._base_key, pos), self._rng_calls
+        )
+        out, self.cache = block(
+            self.params,
+            arr,
+            self.cache,
+            jnp.int32(pos),
+            rng,
+            jnp.float32(max(self.temperature, 1e-6)),
+            jnp.float32(self.sampler.topp),
+        )
         return [int(t) for t in np.asarray(out)[:, 0]]
 
     def _bucket_for(self, n: int, pos: int) -> int:
@@ -306,8 +369,7 @@ class InferenceEngine:
         token = prompt_tokens[-1]
         out_tokens: list[int] = []
         pred_ms = 0.0
-        greedy = self.temperature == 0.0
-        block = max(1, block_size) if greedy else 1
+        block = max(1, block_size)
         stopped = False
         while pos < max_pos and not stopped:
             if block > 1:

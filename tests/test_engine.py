@@ -187,3 +187,29 @@ def test_quant_rejects_non_q40(tmp_path):
     make_tiny_model(mp, weight_type=FloatType.F32)
     with pytest.raises(ValueError, match="q40"):
         InferenceEngine(mp, tp=1, dtype=jnp.float32, weight_format="q40")
+
+
+def test_telemetry_report_and_ici():
+    from dllama_tpu.models.synthetic import make_header, random_params
+    from dllama_tpu.models import init_kv_cache
+    from dllama_tpu.utils.telemetry import ici_traffic_per_token, memory_report
+
+    h = make_header("tiny")
+    params = random_params(h, dtype=jnp.float32)
+    cache = init_kv_cache(h, 1)
+    rep2 = memory_report(params, cache, n_devices=2)
+    rep8 = memory_report(params, cache, n_devices=8)
+    assert rep2.params_bytes > 0 and rep2.cache_bytes > 0
+    assert 0 < rep2.replicated_bytes < rep2.total_bytes
+    # the replicated portion must not shrink with chip count: per-chip at
+    # 8 devices stays above a pure total/8 split by ~the replicated bytes
+    assert rep8.per_device_bytes >= rep8.total_bytes // 8
+    assert rep8.per_device_bytes - rep8.total_bytes // 8 >= int(
+        rep8.replicated_bytes * 0.8
+    )
+    rep = rep2
+    assert ici_traffic_per_token(h, 1) == 0
+    t2 = ici_traffic_per_token(h, 2)
+    t4 = ici_traffic_per_token(h, 4)
+    assert t4 > t2 > 0
+    assert ici_traffic_per_token(h, 2, include_logits=False) < t2
