@@ -127,7 +127,9 @@ def make_tiny_model(
     return {name: arr for name, (arr, ft) in tensors.items()}
 
 
-def make_tiny_tokenizer(path, chat_template: str | None = None) -> TokenizerData:
+def make_tiny_tokenizer(
+    path, chat_template: str | None = None, pad_to: int = 0
+) -> TokenizerData:
     """A tiny byte-level tokenizer: 256 single-byte regular tokens, then a few
     merged tokens, then specials. Regular/special split at bos_id, matching
     the reference layout assumption (src/tokenizer.cpp:138-140)."""
@@ -141,11 +143,20 @@ def make_tiny_tokenizer(path, chat_template: str | None = None) -> TokenizerData
     for i, m in enumerate(merges):
         vocab.append(m)
         scores.append(float(i + 1))
-    bos_id = len(vocab)
     specials = [b"<s>", b"</s>", b"<|eot|>"]
+    # pad the regular vocab so tokenizer size can match a model's vocab
+    # (reference decode indexes vocab[token] for any sampled id)
+    if pad_to:
+        assert pad_to >= len(vocab) + len(specials), (pad_to, len(vocab))
+        while len(vocab) < pad_to - len(specials):
+            vocab.append(f"<pad{len(vocab)}>".encode())
+            scores.append(0.0)
+    bos_id = len(vocab)
     for s in specials:
         vocab.append(s)
         scores.append(0.0)
+    if pad_to:
+        assert len(vocab) == pad_to, (len(vocab), pad_to)
     data = TokenizerData(
         vocab=vocab,
         scores=scores,
