@@ -26,6 +26,7 @@ import os
 import re
 import shutil
 import subprocess
+import sys
 
 import jax.numpy as jnp
 import pytest
@@ -184,13 +185,22 @@ def extract_reference_pieces(stdout: str) -> str:
     return "".join(pieces)
 
 
-def run_parity(dllama_binary, tmp_path, arch, seed, prompt, steps):
-    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
-               head_dim=16, vocab_size=288, seq_len=96)
+PARITY_CFG = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
+                  head_dim=16, vocab_size=288, seq_len=96)
+
+
+def make_parity_fixture(tmp_path, seed, arch=LlmArch.LLAMA):
     mp = str(tmp_path / "m.m")
     tp = str(tmp_path / "t.t")
-    make_tiny_model(mp, arch=arch, weight_type=FloatType.F32, cfg=cfg, seed=seed)
-    make_tiny_tokenizer(tp, pad_to=288)
+    make_tiny_model(
+        mp, arch=arch, weight_type=FloatType.F32, cfg=dict(PARITY_CFG), seed=seed
+    )
+    make_tiny_tokenizer(tp, pad_to=PARITY_CFG["vocab_size"])
+    return mp, tp
+
+
+def run_parity(dllama_binary, tmp_path, arch, seed, prompt, steps):
+    mp, tp = make_parity_fixture(tmp_path, seed, arch)
 
     r = subprocess.run(
         [dllama_binary, "inference", "--model", mp, "--tokenizer", tp,
@@ -229,3 +239,33 @@ def test_greedy_stream_matches_reference_qwen3(dllama_binary, tmp_path):
 def test_greedy_stream_matches_reference_fresh(dllama_binary, tmp_path):
     """A third seed/prompt to guard against fixture-tuned coincidences."""
     run_parity(dllama_binary, tmp_path, LlmArch.LLAMA, 23, "hi there world", 18)
+
+
+def test_perplexity_matches_reference(dllama_binary, tmp_path):
+    """Perplexity (teacher-forced NLL) parity — the numerical-quality oracle
+    (reference: dllama.cpp:132-172) compared across implementations."""
+    mp, tp = make_parity_fixture(tmp_path, seed=31)
+    prompt = "hello world the world hello"
+
+    r = subprocess.run(
+        [dllama_binary, "perplexity", "--model", mp, "--tokenizer", tp,
+         "--prompt", prompt, "--nthreads", "1", "--buffer-float-type", "f32"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    m = re.search(r"perplexity: ([0-9.]+)", r.stdout)
+    assert m, r.stdout[-500:]
+    ref_ppl = float(m.group(1))
+
+    cli = subprocess.run(
+        [sys.executable, "-m", "dllama_tpu", "perplexity", "--model", mp,
+         "--tokenizer", tp, "--prompt", prompt, "--dtype", "f32", "--tp", "1"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd="/root/repo",
+    )
+    assert cli.returncode == 0, cli.stderr[-800:]
+    m2 = re.search(r"perplexity: ([0-9.]+)", cli.stdout)
+    assert m2, cli.stdout[-500:]
+    ours_ppl = float(m2.group(1))
+    assert abs(ours_ppl - ref_ppl) / ref_ppl < 2e-3, (ours_ppl, ref_ppl)
