@@ -20,9 +20,10 @@ import time
 from functools import partial
 
 import jax
-from dllama_tpu.parallel.mesh import reassert_platform
+from dllama_tpu.parallel.mesh import enable_compilation_cache, reassert_platform
 
 reassert_platform()
+enable_compilation_cache()
 
 import jax.numpy as jnp
 import numpy as np

@@ -298,9 +298,10 @@ def run_perplexity(args) -> None:
 
 
 def main(argv=None) -> None:
-    from .parallel.mesh import reassert_platform
+    from .parallel.mesh import enable_compilation_cache, reassert_platform
 
     reassert_platform()
+    enable_compilation_cache()
     args = _build_parser().parse_args(argv)
     if args.mode == "worker":
         raise SystemExit(

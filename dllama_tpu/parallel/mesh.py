@@ -38,6 +38,27 @@ def reassert_platform() -> None:
         jax.config.update("jax_platforms", requested)
 
 
+def enable_compilation_cache(cache_dir: str | None = None) -> None:
+    """Persistent XLA compilation cache: decode/prefill programs survive
+    process restarts (first TPU compile costs 20-40s; the reference has no
+    compilation to cache, but its 'workers receive prebuilt graphs' startup
+    is the analogous amortization). Respects JAX_COMPILATION_CACHE_DIR."""
+    import os
+
+    if jax.config.jax_compilation_cache_dir:
+        return  # the user already configured a cache; don't clobber it
+    path = (
+        cache_dir
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.expanduser("~/.cache/dllama_tpu/xla")
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:
+        pass  # cache is an optimization; never fail startup over it
+
+
 def validate_tp(h: LlmHeader, tp: int) -> None:
     """Mirror the reference's shardability constraints (src/app.cpp:236-240
     requires nNodes ≤ nKvHeads and 2^n nodes; the dimension divisibility

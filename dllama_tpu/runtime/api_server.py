@@ -411,9 +411,10 @@ def main(argv=None) -> None:
     add_engine_args(parser)
     args = parser.parse_args(argv)
 
-    from ..parallel.mesh import reassert_platform
+    from ..parallel.mesh import enable_compilation_cache, reassert_platform
 
     reassert_platform()
+    enable_compilation_cache()
 
     # crash-and-retry outer loop (reference: dllama-api retries whole app
     # init every 3 s, dllama-api.cpp:616-628). Transient failures

@@ -69,6 +69,11 @@ class StepStats:
 
 
 class InferenceEngine:
+    """See module docstring. `batch_size` > 1 turns the batch axis into
+    independent decoding lanes (`generate_batch`) — the data-parallel
+    throughput surface the reference lacks (SURVEY.md §2 marks DP absent
+    there)."""
+
     def __init__(
         self,
         model_path: str,
@@ -243,14 +248,26 @@ class InferenceEngine:
         self._compiled[key] = block
         return block
 
-    def decode_block(self, token: int, pos: int, n_steps: int) -> list[int]:
+    def decode_block(
+        self, token: int | list[int], pos: int, n_steps: int
+    ) -> list[int] | list[list[int]]:
         """Decode up to `n_steps` tokens in one device dispatch (greedy when
-        temperature == 0, on-device temperature/top-p sampling otherwise)."""
-        if pos + n_steps > self.header.seq_len:
-            n_steps = self.header.seq_len - pos
+        temperature == 0, on-device temperature/top-p sampling otherwise).
+
+        `token` may be a per-lane list (one independent sequence per batch
+        lane, the dp axis); the return is then [n_steps][lanes]."""
+        per_lane = isinstance(token, (list, tuple))
+        n_steps = min(n_steps, self._block_width(pos, n_steps))
         if n_steps <= 0:
             return []
-        arr = jnp.asarray([[token]] * self.batch_size, dtype=jnp.int32)
+        if per_lane:
+            if len(token) != self.batch_size:
+                raise ValueError(
+                    f"{len(token)} lane tokens for batch_size {self.batch_size}"
+                )
+            arr = jnp.asarray([[t] for t in token], dtype=jnp.int32)
+        else:
+            arr = jnp.asarray([[token]] * self.batch_size, dtype=jnp.int32)
         arr = jax.device_put(arr, self._token_sharding)
         greedy = self.temperature == 0.0
         block = self._decode_block_fn(n_steps, greedy)
@@ -269,7 +286,10 @@ class InferenceEngine:
             jnp.float32(max(self.temperature, 1e-6)),
             jnp.float32(self.sampler.topp),
         )
-        return [int(t) for t in np.asarray(out)[:, 0]]
+        out = np.asarray(out)  # [n_steps, lanes]
+        if per_lane:
+            return [[int(t) for t in row] for row in out]
+        return [int(t) for t in out[:, 0]]
 
     def _bucket_for(self, n: int, pos: int) -> int:
         """Smallest bucket covering n tokens whose PADDED extent still fits
@@ -291,28 +311,37 @@ class InferenceEngine:
     def prefill(self, tokens: list[int], pos: int = 0) -> StepStats:
         """Run all but the last prompt token through the cache (the last
         token is the decode loop's first input, reference: dllama.cpp:38-68)."""
-        assert len(tokens) >= 1
-        if pos + len(tokens) - 1 > self.header.seq_len:
+        if len(tokens) < 1:
+            raise ValueError("empty prompt")
+        return self._prefill_rows([tokens] * self.batch_size, pos)
+
+    def _prefill_rows(self, rows: list[list[int]], pos: int = 0) -> StepStats:
+        """Chunked, bucketed prefill of per-lane token rows (all the same
+        length); everything but the last token of each row enters the cache."""
+        n = len(rows[0])
+        if pos + n - 1 > self.header.seq_len:
             # dynamic_update_slice clamps silently; fail loudly instead
             # (the reference bounds pos by seqLen the same way,
             # dllama.cpp:27-28,76).
             raise ValueError(
-                f"prompt of {len(tokens)} tokens at pos {pos} exceeds "
+                f"prompt of {n} tokens at pos {pos} exceeds "
                 f"seqLen {self.header.seq_len}"
             )
-        fill = tokens[:-1]
+        fills = [row[:-1] for row in rows]
         total_ms = 0.0
         p = pos
-        while fill:
-            bucket = self._bucket_for(len(fill), p)
-            chunk = fill[:bucket]
-            fill = fill[bucket:]
-            padded = chunk + [0] * (bucket - len(chunk))
-            arr = jnp.asarray([padded] * self.batch_size, dtype=jnp.int32)
+        while fills[0]:
+            bucket = self._bucket_for(len(fills[0]), p)
+            width = min(bucket, len(fills[0]))
+            padded = [
+                fill[:width] + [0] * (bucket - width) for fill in fills
+            ]
+            fills = [fill[width:] for fill in fills]
+            arr = jnp.asarray(padded, dtype=jnp.int32)
             arr = jax.device_put(arr, self._token_sharding)
             step = self._step_fn(bucket, greedy=False)
             t0 = time.perf_counter()
-            # Padding tokens write garbage into cache slots [p+len(chunk),
+            # Padding tokens write garbage into cache slots [p+width,
             # p+bucket) — harmless: the causal mask hides them until real
             # tokens overwrite those positions.
             _, self.cache = step(self.params, arr, self.cache, jnp.int32(p))
@@ -320,8 +349,15 @@ class InferenceEngine:
             # on the tunneled axon TPU platform)
             np.asarray(jax.device_get(self.cache["k"][0, 0, 0, 0, 0]))
             total_ms += (time.perf_counter() - t0) * 1000
-            p += len(chunk)
-        return StepStats(time_ms=total_ms, n_tokens=max(len(tokens) - 1, 0))
+            p += width
+        return StepStats(time_ms=total_ms, n_tokens=max(n - 1, 0))
+
+    def _block_width(self, pos: int, block: int) -> int:
+        """Block size to run at `pos`: the full compiled width whenever it
+        fits the cache, else the exact remaining space."""
+        if pos + block <= self.header.seq_len:
+            return block
+        return self.header.seq_len - pos
 
     def decode_step(self, token: int, pos: int) -> tuple[int, StepStats]:
         """One decode step: feed `token` at `pos`, return the sampled next
@@ -376,9 +412,7 @@ class InferenceEngine:
                 # run the full block size whenever it fits in the cache
                 # (compiling a one-off program per tail length costs seconds
                 # on this platform); surplus tokens are simply not consumed
-                n = block if pos + block <= self.header.seq_len else (
-                    self.header.seq_len - pos
-                )
+                n = self._block_width(pos, block)
                 want = min(n, max_pos - pos)
                 t0 = time.perf_counter()
                 toks = self.decode_block(token, pos, n)[:want]
@@ -405,4 +439,38 @@ class InferenceEngine:
                 if stop_condition is not None and stop_condition(token):
                     break
         return out_tokens, eval_stats, StepStats(pred_ms, len(out_tokens))
+
+    def generate_batch(
+        self,
+        prompts: list[list[int]],
+        max_steps: int,
+        block_size: int = 8,
+    ) -> list[list[int]]:
+        """Decode independent same-length sequences, one per batch lane
+        (requires batch_size == len(prompts)). Greedy/sampled per the
+        engine temperature; returns per-lane token lists."""
+        if len(prompts) != self.batch_size:
+            raise ValueError(
+                f"{len(prompts)} prompts for batch_size {self.batch_size}"
+            )
+        n = len(prompts[0])
+        if not all(len(p) == n for p in prompts):
+            raise ValueError("equal-length prompts required")
+        self._prefill_rows(prompts, 0)
+        pos = n - 1
+        tokens = [p[-1] for p in prompts]
+        outs: list[list[int]] = [[] for _ in prompts]
+        max_pos = min(self.header.seq_len, max_steps)
+        while pos < max_pos:
+            nb = self._block_width(pos, block_size)
+            want = min(nb, max_pos - pos)
+            rows = self.decode_block(tokens, pos, nb)[:want]
+            if not rows:
+                break
+            for row in rows:
+                for lane, t in enumerate(row):
+                    outs[lane].append(t)
+            tokens = rows[-1]
+            pos += len(rows)
+        return outs
 

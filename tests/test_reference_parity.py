@@ -262,7 +262,7 @@ def test_perplexity_matches_reference(dllama_binary, tmp_path):
          "--tokenizer", tp, "--prompt", prompt, "--dtype", "f32", "--tp", "1"],
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
-        cwd="/root/repo",
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert cli.returncode == 0, cli.stderr[-800:]
     m2 = re.search(r"perplexity: ([0-9.]+)", cli.stdout)
