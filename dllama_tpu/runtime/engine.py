@@ -257,7 +257,7 @@ class InferenceEngine:
         `token` may be a per-lane list (one independent sequence per batch
         lane, the dp axis); the return is then [n_steps][lanes]."""
         per_lane = isinstance(token, (list, tuple))
-        n_steps = min(n_steps, self._block_width(pos, n_steps))
+        n_steps = self._block_width(pos, n_steps)
         if n_steps <= 0:
             return []
         if per_lane:
@@ -311,14 +311,14 @@ class InferenceEngine:
     def prefill(self, tokens: list[int], pos: int = 0) -> StepStats:
         """Run all but the last prompt token through the cache (the last
         token is the decode loop's first input, reference: dllama.cpp:38-68)."""
-        if len(tokens) < 1:
-            raise ValueError("empty prompt")
         return self._prefill_rows([tokens] * self.batch_size, pos)
 
     def _prefill_rows(self, rows: list[list[int]], pos: int = 0) -> StepStats:
         """Chunked, bucketed prefill of per-lane token rows (all the same
         length); everything but the last token of each row enters the cache."""
         n = len(rows[0])
+        if n < 1:
+            raise ValueError("empty prompt")
         if pos + n - 1 > self.header.seq_len:
             # dynamic_update_slice clamps silently; fail loudly instead
             # (the reference bounds pos by seqLen the same way,

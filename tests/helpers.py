@@ -4,7 +4,11 @@ is exercised exactly as it is for real checkpoints."""
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 from dllama_tpu.formats import FloatType
 from dllama_tpu.formats.model_file import LlmArch

@@ -13,7 +13,7 @@ from dllama_tpu.formats import FloatType
 from dllama_tpu.runtime.engine import InferenceEngine
 from dllama_tpu.tokenizer import Tokenizer
 
-from helpers import make_tiny_model, make_tiny_tokenizer
+from helpers import REPO_ROOT, make_tiny_model, make_tiny_tokenizer
 
 
 @pytest.fixture()
@@ -93,7 +93,7 @@ def _run_cli(args, env_extra=None):
         capture_output=True,
         text=True,
         env=env,
-        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        cwd=REPO_ROOT,
         timeout=600,
     )
 
