@@ -35,12 +35,56 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _device_watchdog(timeout_s: float = 180.0) -> None:
+    """The tunneled TPU platform HANGS (rather than erroring) when its
+    relay is down; probe it under a timer so the bench emits a result line
+    and exits instead of wedging the driver."""
+    import threading
+
+    done = threading.Event()
+    result: dict = {}
+
+    def probe():
+        try:
+            import numpy as _np
+
+            import jax.numpy as _jnp
+
+            _ = _np.asarray(_jnp.ones((8, 8)) @ _jnp.ones((8, 8)))
+            result["ok"] = True
+        except Exception as e:  # real error: report it, don't fake a timeout
+            result["error"] = f"{type(e).__name__}: {e}"
+        finally:
+            done.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    done.wait(timeout_s)
+    if not result.get("ok"):
+        print(
+            json.dumps(
+                {
+                    "metric": "decode_tok_s_per_chip_unavailable",
+                    "value": 0.0,
+                    "unit": "tokens/s/chip",
+                    "vs_baseline": 0.0,
+                    "error": result.get(
+                        "error", "accelerator unreachable (device probe timed out)"
+                    ),
+                }
+            )
+        )
+        os._exit(0)
+
+
 def main() -> None:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from dllama_tpu.models import forward, init_kv_cache
     from dllama_tpu.models.synthetic import make_header, random_params
     from dllama_tpu.parallel import cache_specs, make_mesh
+
+    _device_watchdog()
 
     preset = os.environ.get("BENCH_PRESET", "llama-1b")
     steps = int(os.environ.get("BENCH_STEPS", "64"))

@@ -33,6 +33,7 @@ from ..formats.model_file import HiddenAct, LlmArch, LlmHeader, RopeType
 from ..ops.jnp_ops import apply_rope, gelu, qk_rms_norm, rms_norm, silu
 from ..ops.quant_matmul import QuantWeight, qmatmul_tp
 from ..ops.flash_attention import flash_attention, pick_flash_blocks
+from ..ops.moe_kernel import moe_active_experts
 
 Params = Dict[str, Any]
 KvCache = Dict[str, jnp.ndarray]
@@ -123,6 +124,19 @@ def _attention(
     return out.reshape(b, t, n_heads * head_dim)
 
 
+def _moe_route(x_flat: jnp.ndarray, gate_w: jnp.ndarray, n_active: int):
+    """Shared gate routing (softmax over all experts -> top-k -> normTopk=1
+    weights; reference: src/nn/nn-cpu-ops.cpp:1462-1492). `x_flat` is
+    [..., D]; returns (top_i [..., k], weights [..., k]) in f32."""
+    logits = jnp.einsum(
+        "...d,de->...e", x_flat.astype(jnp.float32), gate_w.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_i = lax.top_k(probs, n_active)
+    weights = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    return top_i, weights
+
+
 def _moe_ffn(
     x: jnp.ndarray,  # [B, T, D]
     gate_w: jnp.ndarray,  # [D, E]
@@ -145,12 +159,7 @@ def _moe_ffn(
     once the Pallas ragged kernel lands (SURVEY.md §7 hard parts).
     """
     e = gate_w.shape[1]
-    logits = jnp.einsum(
-        "btd,de->bte", x.astype(jnp.float32), gate_w.astype(jnp.float32)
-    )
-    probs = jax.nn.softmax(logits, axis=-1)  # [B, T, E]
-    top_p, top_i = lax.top_k(probs, n_active)  # [B, T, k]
-    weights = top_p / jnp.sum(top_p, axis=-1, keepdims=True)  # normTopk=1
+    top_i, weights = _moe_route(x, gate_w, n_active)  # [B, T, k]
 
     # routing matrix [B, T, E]: normalized weight where selected, else 0
     routing = jnp.sum(
@@ -189,12 +198,7 @@ def _moe_ffn_gather(
     b, t, d = x.shape
     n = b * t
     xf = x.reshape(n, d)
-    logits = jnp.einsum(
-        "nd,de->ne", xf.astype(jnp.float32), gate_w.astype(jnp.float32)
-    )
-    probs = jax.nn.softmax(logits, axis=-1)
-    top_p, top_i = lax.top_k(probs, n_active)  # [n, k]
-    weights = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    top_i, weights = _moe_route(xf, gate_w, n_active)  # [n, k]
 
     w1_sel = jnp.take(w1, top_i.reshape(-1), axis=0)  # [n*k, D, F]
     w3_sel = jnp.take(w3, top_i.reshape(-1), axis=0)
@@ -210,6 +214,55 @@ def _moe_ffn_gather(
     out = jnp.einsum(
         "nkd,nk->nd", expert_out.astype(jnp.float32), weights
     )
+    return out.reshape(b, t, d).astype(x.dtype)
+
+
+def _moe_ffn_pallas(
+    x: jnp.ndarray,  # [B, T, D] with B*T == 1
+    gate_w: jnp.ndarray,
+    w1: jnp.ndarray,  # [E, D, F]
+    w2: jnp.ndarray,  # [E, F, D]
+    w3: jnp.ndarray,  # [E, D, F]
+    n_active: int,
+    mesh,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Decode-step MoE via the ragged Pallas kernel (ops/moe_kernel.py):
+    the top-k expert ids drive the HBM->VMEM DMA schedule, so only active
+    experts' weights are read. TP: experts are hidden-dim sliced like the
+    reference (w1/w3 row-split, w2 col-split, llm.cpp:450-487), so each
+    shard computes its slice and the partial outputs psum over ICI."""
+    b, t, d = x.shape
+    xf = x.reshape(1, d)
+    top_i, weights = _moe_route(xf, gate_w, n_active)
+    top_i, weights = top_i[0], weights[0]
+
+    if mesh is None or mesh.devices.size == 1:
+        out = moe_active_experts(xf, w1, w2, w3, top_i, weights, interpret=interpret)
+    else:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def body(xx, ww1, ww2, ww3, ii, wts):
+            return lax.psum(
+                moe_active_experts(xx, ww1, ww2, ww3, ii, wts, interpret=interpret),
+                "tp",
+            )
+
+        out = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                P(),
+                P(None, None, "tp"),
+                P(None, "tp", None),
+                P(None, None, "tp"),
+                P(),
+                P(),
+            ),
+            out_specs=P(),
+            check_vma=False,
+        )(xf, w1, w2, w3, top_i, weights)
     return out.reshape(b, t, d).astype(x.dtype)
 
 
@@ -271,22 +324,34 @@ def forward(
         # -- FFN block (reference: src/llm.cpp:405-557) --
         y = rms_norm(x, lp["ffn_norm"], h.norm_epsilon)
         if h.arch == LlmArch.QWEN3_MOE:
-            # decode-path expert gather is available but OFF by default:
-            # measured on v5e, XLA lowers the 8-of-128 expert jnp.take to
-            # something ~3x slower than the dense all-expert einsum at
-            # B*T=1 (the fused ragged kernel is the real fix, SURVEY.md §7)
-            moe = (
-                _moe_ffn_gather if b * t <= moe_gather_max_tokens else _moe_ffn
-            )
-            f = moe(
-                y,
-                lp["moe_gate"],
-                lp["w1"],
-                lp["w2"],
-                lp["w3"],
-                h.n_active_experts,
-                act,
-            )
+            # decode (one token): the ragged Pallas kernel reads only the
+            # active experts' weights. Prefill / CPU: dense-over-experts
+            # (XLA's jnp.take gather measured ~3x slower than even dense,
+            # so the gather path stays opt-in via moe_gather_max_tokens).
+            if (
+                b * t == 1
+                and h.hidden_act == HiddenAct.SILU
+                and jax.default_backend() == "tpu"
+            ):
+                f = _moe_ffn_pallas(
+                    y, lp["moe_gate"], lp["w1"], lp["w2"], lp["w3"],
+                    h.n_active_experts, mesh,
+                )
+            else:
+                moe = (
+                    _moe_ffn_gather
+                    if b * t <= moe_gather_max_tokens
+                    else _moe_ffn
+                )
+                f = moe(
+                    y,
+                    lp["moe_gate"],
+                    lp["w1"],
+                    lp["w2"],
+                    lp["w3"],
+                    h.n_active_experts,
+                    act,
+                )
         else:
             d = act(_mm(y, lp["w1"], "row", mesh))
             l = _mm(y, lp["w3"], "row", mesh)

@@ -1,0 +1,113 @@
+"""Ragged MoE dispatch: Pallas kernel computing ONLY the active experts.
+
+The decode-path answer to SURVEY.md §7's "MoE top-k on TPU with tiny active
+expert counts (A3B: 8 of 128) without wasting a dense 128-expert matmul".
+The reference walks an indexes buffer and runs just the selected experts'
+matmuls (src/nn/nn-cpu-ops.cpp:1104-1136); the straightforward XLA
+restatement (`jnp.take` of the expert weights) measures ~3x slower than
+even the dense all-expert einsum on v5e, because the gather materializes
+the selected weights through HBM.
+
+This kernel instead makes the expert id part of the DMA schedule: the
+top-k indices arrive via scalar prefetch and the BlockSpec index_map picks
+which expert's weight tile to copy HBM->VMEM per grid step — the selected
+expert weights are read exactly once, nothing else moves.
+
+Grid: (k,) active experts, one SwiGLU expert pipeline per step, output
+accumulated in VMEM scratch weighted by the routing probabilities.
+Decode-sized (B*T small); prefill keeps the dense path where every expert
+is busy anyway.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _moe_kernel(
+    idx_ref,  # scalar prefetch: [k] int32 expert ids
+    w_ref,  # scalar prefetch: [k] f32 routing weights (SMEM)
+    x_ref,  # [m, D]
+    w1_ref,  # [1, D, F] (selected expert)
+    w3_ref,  # [1, D, F]
+    w2_ref,  # [1, F, D]
+    o_ref,  # [m, D]
+    acc_ref,  # VMEM [m, D] f32
+    *,
+    n_k: int,
+):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[:]  # [m, D]
+    h1 = jax.lax.dot_general(
+        x, w1_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    h3 = jax.lax.dot_general(
+        x, w3_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    hidden = (h1 / (1.0 + jnp.exp(-h1))) * h3  # silu(w1 x) * (w3 x), f32
+    out = jax.lax.dot_general(
+        hidden.astype(x.dtype), w2_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[:] += out * w_ref[i]
+
+    @pl.when(i == n_k - 1)
+    def _emit():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def moe_active_experts(
+    x: jnp.ndarray,  # [m, D] tokens (decode-sized m)
+    w1: jnp.ndarray,  # [E, D, F]
+    w2: jnp.ndarray,  # [E, F, D]
+    w3: jnp.ndarray,  # [E, D, F]
+    top_i: jnp.ndarray,  # [k] int32 selected expert ids (shared by the m tokens)
+    weights: jnp.ndarray,  # [k] f32 normalized routing weights
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """SwiGLU-MoE over exactly the selected experts; returns [m, D] f32.
+
+    Note the single shared top-k set: decode with m == 1 is the target. For
+    m > 1 each token generally routes differently — that stays on the dense
+    path.
+    """
+    m, d = x.shape
+    e, _, f = w1.shape
+    k = top_i.shape[0]
+
+    def x_map(i, idx_ref, w_ref):
+        return (0, 0)
+
+    def w_sel_map(i, idx_ref, w_ref):
+        return (idx_ref[i], 0, 0)
+
+    return pl.pallas_call(
+        functools.partial(_moe_kernel, n_k=k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(k,),
+            in_specs=[
+                pl.BlockSpec((m, d), x_map),
+                pl.BlockSpec((1, d, f), w_sel_map),
+                pl.BlockSpec((1, d, f), w_sel_map),
+                pl.BlockSpec((1, f, d), w_sel_map),
+            ],
+            out_specs=pl.BlockSpec((m, d), x_map),
+            scratch_shapes=[pltpu.VMEM((m, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, d), jnp.float32),
+        interpret=interpret,
+    )(top_i, weights.astype(jnp.float32), x, w1, w3, w2)

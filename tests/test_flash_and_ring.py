@@ -81,3 +81,25 @@ def test_ring_with_tp_mesh_axes():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(expected), rtol=2e-4, atol=2e-4
     )
+
+
+def test_moe_pallas_tp_branch_matches_dense():
+    """The shard_map TP branch of the ragged MoE path (psum over F-sliced
+    experts) vs the dense MoE, on a tp=2 CPU mesh in interpret mode."""
+    from dllama_tpu.models.transformer import _moe_ffn, _moe_ffn_pallas
+    from dllama_tpu.ops.jnp_ops import silu
+
+    rng = np.random.default_rng(21)
+    E, D, F, K = 8, 64, 128, 3
+    w1 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.standard_normal((E, F, D)).astype(np.float32) * 0.1)
+    w3 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.1)
+    gate = jnp.asarray(rng.standard_normal((D, E)).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((1, 1, D)).astype(np.float32))
+
+    mesh = make_mesh(tp=2)
+    out = _moe_ffn_pallas(x, gate, w1, w2, w3, K, mesh, interpret=True)
+    dense = _moe_ffn(x, gate, w1, w2, w3, K, silu)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense), rtol=1e-4, atol=1e-4
+    )

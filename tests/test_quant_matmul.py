@@ -65,3 +65,32 @@ def test_quantweight_is_pytree():
     assert stacked.q.shape == (2, 64, 64)
     leaves = jax.tree.leaves(qw)
     assert len(leaves) == 2
+
+
+def test_moe_active_experts_kernel():
+    """Ragged MoE kernel vs the dense jnp path (interpret mode)."""
+    import jax
+    from jax import lax
+
+    from dllama_tpu.ops.moe_kernel import moe_active_experts
+
+    rng = np.random.default_rng(2)
+    E, D, F, K = 8, 64, 96, 3
+    w1 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.standard_normal((E, F, D)).astype(np.float32) * 0.1)
+    w3 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.1)
+    gate = jnp.asarray(rng.standard_normal((D, E)).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((1, D)).astype(np.float32))
+
+    probs = jax.nn.softmax(x @ gate, axis=-1)
+    top_p, top_i = lax.top_k(probs[0], K)
+    weights = top_p / top_p.sum()
+    out = moe_active_experts(x, w1, w2, w3, top_i, weights, interpret=True)
+
+    from dllama_tpu.models.transformer import _moe_ffn
+    from dllama_tpu.ops.jnp_ops import silu
+
+    dense = _moe_ffn(x[None], gate, w1, w2, w3, K, silu)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense)[0], rtol=1e-5, atol=1e-5
+    )
