@@ -126,6 +126,11 @@ def load_engine(args):
         print(f"💡 nActiveExperts: {h.n_active_experts}")
     print(f"💡 SeqLen: {h.seq_len}")
     print(f"💡 Tp: {tp} chip(s) [{jax.default_backend()}]")
+    if tok.vocab_size != h.vocab_size:
+        print(
+            f"⚠️  tokenizer vocab ({tok.vocab_size}) != model vocab "
+            f"({h.vocab_size}); decoding may fail for out-of-range tokens"
+        )
     print(f"💡 WeightFormat: {engine.weight_format}")
     from .utils.telemetry import memory_report
 
@@ -242,15 +247,11 @@ def run_chat(args) -> None:
             tok.eos_token_ids, stops, padding_left=2, padding_right=2
         )
         print("\n🤖 Assistant: ", end="", flush=True)
-        engine.prefill(tokens, pos=pos)
-        pos += len(tokens) - 1
-        token = tokens[-1]
         tok.reset_decoder()
-        while pos < engine.header.seq_len - 1:
-            token, _ = engine.decode_step(token, pos)
-            pos += 1
-            piece = tok.decode(token)
-            res = detector.append(token, piece)
+
+        def on_token(t: int):
+            piece = tok.decode(t)
+            res = detector.append(t, piece)
             if res == EosResult.NOT_EOS:
                 delta = detector.get_delta()
                 if delta:
@@ -260,7 +261,16 @@ def run_chat(args) -> None:
                 delta = detector.get_delta()
                 if delta:
                     print(delta, end="", flush=True)
-                break
+                return False
+            return True
+
+        out, _, _ = engine.generate(
+            tokens,
+            max_steps=engine.header.seq_len - 1 - pos,
+            on_token=on_token,
+            start_pos=pos,
+        )
+        pos += len(tokens) - 1 + len(out)
         print()
 
 

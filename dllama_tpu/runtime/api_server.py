@@ -164,9 +164,6 @@ class ApiState:
             emit(prompt.public_prompt)
             buffer += prompt.public_prompt
 
-        engine.prefill(tokens, pos=start_pos)
-        pos = prompt_end_pos
-        token = tokens[-1]
         tok.reset_decoder()
         detector = EosDetector(
             tok.eos_token_ids,
@@ -175,24 +172,44 @@ class ApiState:
             padding_right=self.max_stop_len,
         )
 
-        hit_eos = False
-        while pos < max_pred_pos:
-            token, _ = engine.decode_step(token, pos)
-            piece = tok.decode(token)
-            eos_type = detector.append(token, piece)
+        # On-device block decode via the engine's shared loop (one host
+        # dispatch per ~8 tokens). EOS is detected per consumed token; the
+        # KV rows a block wrote past the stop are masked garbage until the
+        # next prefill overwrites them. NB: sampled (temperature>0) decode
+        # uses the engine's on-device JAX PRNG — seeded-reproducible, but a
+        # different RNG than the reference's xorshift host sampler (which
+        # remains available via engine.decode_step / Sampler).
+        state = {"hit_eos": False, "buffer": buffer}
+
+        def on_token(t: int):
+            piece = tok.decode(t)
+            eos_type = detector.append(t, piece)
             if eos_type in (EosResult.NOT_EOS, EosResult.EOS):
                 delta = detector.get_delta()
                 if delta:
                     emit(delta)
-                    buffer += delta
+                    state["buffer"] += delta
                 detector.reset()
-            pos += 1
             if eos_type == EosResult.EOS:
-                hit_eos = True
-                break
+                state["hit_eos"] = True
+                return False
+            return True
+
+        out_tokens, _, _ = engine.generate(
+            tokens,
+            max_steps=max_pred_pos - start_pos,
+            on_token=on_token,
+            start_pos=start_pos,
+        )
+        pos = prompt_end_pos + len(out_tokens)
+        token = out_tokens[-1] if out_tokens else tokens[-1]
+        hit_eos = state["hit_eos"]
+        buffer = state["buffer"]
 
         n_completion = pos - prompt_end_pos
         if not hit_eos and pos < seq_len:
+            # (block decode already wrote this KV row if the block ran past
+            # max_pred_pos, but re-writing the same row is idempotent)
             # max_tokens truncation: the last sampled token's text is in
             # `buffer` but its KV entry was never written; run one KV-only
             # step so a cached continuation resumes from a complete context

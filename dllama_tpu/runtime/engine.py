@@ -388,6 +388,7 @@ class InferenceEngine:
         on_token=None,
         stop_condition=None,
         block_size: int = 8,
+        start_pos: int = 0,
     ):
         """Prefill + decode loop. Yields nothing; returns (tokens, eval_stats,
         pred_stats). `on_token(token)` fires per generated token and may
@@ -399,9 +400,11 @@ class InferenceEngine:
         written KV rows beyond the stop as garbage, which is safe — they
         are causally masked and overwritten by the next prefill at those
         positions."""
-        max_pos = min(self.header.seq_len, max_steps)
-        eval_stats = self.prefill(prompt_tokens)
-        pos = len(prompt_tokens) - 1
+        # max_steps counts positions from start_pos (for start_pos == 0 this
+        # is the reference's absolute --steps semantics, dllama.cpp:76)
+        max_pos = min(self.header.seq_len, start_pos + max_steps)
+        eval_stats = self.prefill(prompt_tokens, pos=start_pos)
+        pos = start_pos + len(prompt_tokens) - 1
         token = prompt_tokens[-1]
         out_tokens: list[int] = []
         pred_ms = 0.0

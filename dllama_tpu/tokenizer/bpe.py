@@ -135,6 +135,13 @@ class Tokenizer:
         far or None (reference: src/tokenizer.cpp:291-309)."""
         if token == self.bos_id:
             return None
+        if not 0 <= token < self.vocab_size:
+            # the model's vocab is larger than the tokenizer's (the
+            # reference would read out of bounds here); fail with context
+            raise ValueError(
+                f"token {token} outside tokenizer vocab "
+                f"({self.vocab_size} entries) — model/tokenizer mismatch?"
+            )
         if self.is_eos(token):
             # Flush whatever partial sequence is pending (reference returns the
             # raw pending buffer; we replace the incomplete tail like the
