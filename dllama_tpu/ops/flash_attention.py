@@ -54,24 +54,30 @@ def attention_ref(
     return attention_dense(q, k_cache, v_cache, pos)
 
 
-def _flash_kernel(
-    pos_ref,  # SMEM scalar prefetch: [1] int32 absolute start position
+def _flash_stats_kernel(
+    pos_ref,  # SMEM scalar prefetch: [2] int32 (q_pos0, s_pos0)
     q_ref,  # [1, bt, hd]
     k_ref,  # [1, bs, hd]
     v_ref,  # [1, bs, hd]
-    o_ref,  # [1, bt, hd]
-    m_ref,  # VMEM [bt, 128] running max
-    l_ref,  # VMEM [bt, 128] running denominator
-    acc_ref,  # VMEM [bt, hd] weighted-value accumulator
+    acc_out,  # [1, bt, hd]
+    m_out,  # [1, bt, 128]
+    l_out,  # [1, bt, 128]
+    m_ref,  # VMEM [bt, 128]
+    l_ref,  # VMEM [bt, 128]
+    acc_ref,  # VMEM [bt, hd]
     *,
     block_t: int,
     block_s: int,
     n_s: int,
     scale: float,
 ):
+    """Like _flash_kernel but emits UNNORMALIZED online-softmax partial
+    state (acc, m, l) — the drop-in local step for ring attention's
+    log-sum-exp merge (parallel/ring_attention.py)."""
     ti = pl.program_id(1)
     si = pl.program_id(2)
-    pos = pos_ref[0]
+    q_pos0 = pos_ref[0] + ti * block_t
+    s_pos0 = pos_ref[1]
 
     @pl.when(si == 0)
     def _init():
@@ -79,12 +85,8 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # absolute positions of this tile's queries and keys
-    q_pos0 = pos + ti * block_t  # first query's absolute position
-    s_start = si * block_s
+    s_start = s_pos0 + si * block_s
 
-    # the whole S block is above the causal diagonal for every query in the
-    # T block -> skip (the highest query position is q_pos0 + block_t - 1)
     @pl.when(s_start <= q_pos0 + block_t - 1)
     def _compute():
         q = q_ref[0].astype(jnp.float32)
@@ -94,16 +96,18 @@ def _flash_kernel(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             )
             * scale
-        )  # [bt, bs]
+        )
         q_pos = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, (block_t, block_s), 0)
         s_pos = s_start + jax.lax.broadcasted_iota(jnp.int32, (block_t, block_s), 1)
         scores = jnp.where(s_pos <= q_pos, scores, _NEG_INF)
-
-        m_prev = m_ref[:, :1]  # [bt, 1]
+        m_prev = m_ref[:, :1]
         m_cur = jnp.max(scores, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)  # rescale of previous state
-        p = jnp.exp(scores - m_new)  # [bt, bs]
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        # fully-masked tiles keep exp(-inf - -inf) out of the stats
+        p = jnp.where(m_new <= _NEG_INF / 2, 0.0, p)
+        alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         v = v_ref[0].astype(jnp.float32)
         pv = jax.lax.dot_general(
@@ -115,15 +119,106 @@ def _flash_kernel(
 
     @pl.when(si == n_s - 1)
     def _emit():
-        # l is 0 only if every key was masked, which cannot happen for a
-        # causal query at position >= 0 (it always sees itself)
-        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        acc_out[0] = acc_ref[:]
+        m_out[0] = m_ref[:]
+        l_out[0] = l_ref[:]
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("block_t", "block_s", "interpret"),
 )
+def flash_attention_stats(
+    q: jnp.ndarray,  # [B, T, H, hd]
+    k: jnp.ndarray,  # [B, S, KH, hd]
+    v: jnp.ndarray,  # [B, S, KH, hd]
+    q_pos0: jnp.ndarray,  # scalar int32: absolute position of q[:, 0]
+    s_pos0: jnp.ndarray,  # scalar int32: absolute position of k[:, 0]
+    block_t: int = 0,
+    block_s: int = 0,
+    interpret: bool = False,
+):
+    """Blockwise causal GQA attention partial state: returns f32
+    (acc [B, KH, G, T, hd], m [B, KH, G, T], l [B, KH, G, T]) — the same
+    contract as ops/jnp_ops.attention_stats, MXU-tiled."""
+    b, t, h, hd = q.shape
+    s, kh = k.shape[1], k.shape[2]
+    g = h // kh
+    if not block_t or not block_s:
+        picked = pick_flash_blocks(t, s)
+        if picked is None:
+            if not interpret:
+                # same contract as flash_attention: Mosaic needs aligned
+                # tiles; callers fall back to the dense path
+                raise ValueError(
+                    f"no valid flash blocks for t={t}, s={s}; use dense attention"
+                )
+            picked = (t, s)  # interpret-mode tests: single tile is fine
+        auto_t, auto_s = picked
+        block_t = block_t or auto_t
+        block_s = block_s or auto_s
+    assert t % block_t == 0 and s % block_s == 0, (t, s, block_t, block_s)
+    n_t = t // block_t
+    n_s = s // block_s
+    scale = 1.0 / (hd**0.5)
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * kh, s, hd)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * kh, s, hd)
+    pos_arr = jnp.stack(
+        [jnp.asarray(q_pos0, jnp.int32), jnp.asarray(s_pos0, jnp.int32)]
+    )
+
+    def q_map(bh, ti, si, pos_ref):
+        return (bh, ti, 0)
+
+    def kv_map(bh, ti, si, pos_ref):
+        bi = bh // h
+        hi = bh % h
+        return (bi * kh + hi // g, si, 0)
+
+    acc, m, l = pl.pallas_call(
+        functools.partial(
+            _flash_stats_kernel,
+            block_t=block_t,
+            block_s=block_s,
+            n_s=n_s,
+            scale=scale,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, n_t, n_s),
+            in_specs=[
+                pl.BlockSpec((1, block_t, hd), q_map),
+                pl.BlockSpec((1, block_s, hd), kv_map),
+                pl.BlockSpec((1, block_s, hd), kv_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_t, hd), q_map),
+                pl.BlockSpec((1, block_t, 128), q_map),
+                pl.BlockSpec((1, block_t, 128), q_map),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_t, 128), jnp.float32),
+                pltpu.VMEM((block_t, 128), jnp.float32),
+                pltpu.VMEM((block_t, hd), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, t, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, t, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(pos_arr, qt, kt, vt)
+
+    # [B*H, T, ...] -> [B, KH, G, T, ...]
+    acc = acc.reshape(b, kh, g, t, hd)
+    m = m[:, :, 0].reshape(b, kh, g, t)
+    l = l[:, :, 0].reshape(b, kh, g, t)
+    return acc, m, l
+
+
 def flash_attention(
     q: jnp.ndarray,  # [B, T, H, hd]
     k_cache: jnp.ndarray,  # [B, S, KH, hd]
@@ -135,71 +230,15 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Blockwise causal GQA attention; returns [B, T, H, hd] in q.dtype.
 
-    Default block sizes come from `pick_flash_blocks`, which guarantees
-    divisibility; explicit blocks must divide t/s."""
+    Implemented as normalize(flash_attention_stats(...)) so one kernel body
+    serves both the dense path and ring attention's partial-state merge; the
+    extra m/l emission is noise next to the score/value traffic.
+    """
     b, t, h, hd = q.shape
-    s, kh = k_cache.shape[1], k_cache.shape[2]
-    g = h // kh
-    if not block_t or not block_s:
-        picked = pick_flash_blocks(t, s)
-        if picked is None:
-            raise ValueError(
-                f"no valid flash blocks for t={t}, s={s}; use dense attention"
-            )
-        auto_t, auto_s = picked
-        block_t = block_t or auto_t
-        block_s = block_s or auto_s
-    assert t % block_t == 0, (t, block_t)
-    assert s % block_s == 0, (s, block_s)
-    n_t = t // block_t
-    n_s = s // block_s
-    scale = 1.0 / (hd**0.5)
-
-    # [B, T, H, hd] -> [B*H, T, hd]; kv gets a broadcast-free gather of the
-    # right kv head per q head via the index map (no repeat materialized)
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
-    kt = k_cache.transpose(0, 2, 1, 3).reshape(b * kh, s, hd)
-    vt = v_cache.transpose(0, 2, 1, 3).reshape(b * kh, s, hd)
-
-    pos_arr = jnp.asarray([pos], dtype=jnp.int32).reshape(1)
-
-    grid = (b * h, n_t, n_s)
-
-    # with num_scalar_prefetch=1 the index maps receive the prefetch ref
-    # as a trailing argument
-    def q_map(bh, ti, si, pos_ref):
-        return (bh, ti, 0)
-
-    def kv_map(bh, ti, si, pos_ref):
-        # q row bh = bi * h + hi -> kv row bi * kh + hi // g
-        bi = bh // h
-        hi = bh % h
-        return (bi * kh + hi // g, si, 0)
-
-    out = pl.pallas_call(
-        functools.partial(
-            _flash_kernel,
-            block_t=block_t,
-            block_s=block_s,
-            n_s=n_s,
-            scale=scale,
-        ),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, block_t, hd), q_map),
-                pl.BlockSpec((1, block_s, hd), kv_map),
-                pl.BlockSpec((1, block_s, hd), kv_map),
-            ],
-            out_specs=pl.BlockSpec((1, block_t, hd), q_map),
-            scratch_shapes=[
-                pltpu.VMEM((block_t, 128), jnp.float32),
-                pltpu.VMEM((block_t, 128), jnp.float32),
-                pltpu.VMEM((block_t, hd), jnp.float32),
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, hd), q.dtype),
-        interpret=interpret,
-    )(pos_arr, qt, kt, vt)
-    return out.reshape(b, h, t, hd).transpose(0, 2, 1, 3)
+    acc, m, l = flash_attention_stats(
+        q, k_cache, v_cache, pos, 0,
+        block_t=block_t, block_s=block_s, interpret=interpret,
+    )
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe[..., None]  # [B, KH, G, T, hd]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, t, h, hd).astype(q.dtype)

@@ -19,10 +19,10 @@ The partial-state combine is the standard log-sum-exp merge:
     m' = max(m1, m2); l' = e^{m1-m'} l1 + e^{m2-m'} l2
     acc' = e^{m1-m'} acc1 + e^{m2-m'} acc2
 
-This module is deliberately jnp-level (einsum inside shard_map): correct on
-any backend, and XLA already overlaps the ppermute with compute. Swapping
-the local step for the Pallas flash kernel is a drop-in once it returns
-(m, l, acc) stats.
+The local step has two backends: the shared jnp einsum math (correct on any
+backend; XLA overlaps the ppermute with compute) and the Pallas flash-stats
+kernel (ops/flash_attention.flash_attention_stats), auto-selected on TPU
+when the shard shapes tile cleanly.
 """
 
 from __future__ import annotations
@@ -36,12 +36,22 @@ from jax import lax
 _NEG_INF = -1e30
 
 
-from ..ops.jnp_ops import attention_stats as _local_attention_stats_impl
+from ..ops.jnp_ops import attention_stats as _stats_jnp
 
 
-def _local_attention_stats(q, k, v, q_pos0, s_pos0):
-    """Shared causal-GQA partial-state math (ops/jnp_ops.attention_stats)."""
-    return _local_attention_stats_impl(q, k, v, q_pos0, s_pos0)
+def _local_attention_stats(
+    q, k, v, q_pos0, s_pos0, use_flash: bool = False, interpret: bool = False
+):
+    """Per-shard causal-GQA partial state: the Pallas flash-stats kernel when
+    requested (TPU hot path — blockwise, no [Tq, Ss] score buffer), else the
+    shared jnp math (ops/jnp_ops.attention_stats)."""
+    if use_flash:
+        from ..ops.flash_attention import flash_attention_stats
+
+        return flash_attention_stats(
+            q, k, v, q_pos0, s_pos0, interpret=interpret
+        )
+    return _stats_jnp(q, k, v, q_pos0, s_pos0)
 
 
 def _merge_stats(acc1, m1, l1, acc2, m2, l2):
@@ -66,6 +76,8 @@ def ring_attention_local(
     q_pos0: jnp.ndarray,  # absolute position of this chip's first query
     shard_size: jnp.ndarray,  # sequence length held per chip (Ss)
     axis_name: str = "sp",
+    use_flash: bool = False,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Per-shard ring attention body; call under shard_map with the sequence
     axis of q/k/v sharded over `axis_name`. Returns [B, Tq, H, hd]."""
@@ -75,7 +87,9 @@ def ring_attention_local(
     def step(carry, _):
         k_cur, v_cur, owner, acc, m, l = carry
         s_pos0 = owner * shard_size
-        acc2, m2, l2 = _local_attention_stats(q, k_cur, v_cur, q_pos0, s_pos0)
+        acc2, m2, l2 = _local_attention_stats(
+            q, k_cur, v_cur, q_pos0, s_pos0, use_flash, interpret
+        )
         acc, m, l = _merge_stats(acc, m, l, acc2, m2, l2)
         # rotate KV one hop: chip i sends to chip (i+1) % sp, so the shard
         # owned by (idx - step - 1) arrives next
@@ -99,7 +113,7 @@ def ring_attention_local(
         carry, _ = lax.scan(step, carry, None, length=sp - 1)
     k_last, v_last, owner, acc, m, l = carry
     acc2, m2, l2 = _local_attention_stats(
-        q, k_last, v_last, q_pos0, owner * shard_size
+        q, k_last, v_last, q_pos0, owner * shard_size, use_flash, interpret
     )
     acc, m, l = _merge_stats(acc, m, l, acc2, m2, l2)
 
@@ -118,6 +132,8 @@ def ring_attention(
     mesh,
     q_pos0: int | jnp.ndarray = 0,
     axis_name: str = "sp",
+    use_flash: bool | None = None,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Driver: shards the sequence axis of q/k/v over `axis_name`, runs the
     ring, returns globally-assembled [B, T, H, hd].
@@ -134,6 +150,13 @@ def ring_attention(
     assert t % sp == 0 and s % sp == 0, (t, s, sp)
     shard_size = s // sp
     tq = t // sp
+    if use_flash is None:
+        from ..ops.flash_attention import pick_flash_blocks
+
+        use_flash = (
+            jax.default_backend() == "tpu"
+            and pick_flash_blocks(tq, shard_size) is not None
+        )
 
     def body(qq, kk, vv):
         idx = lax.axis_index(axis_name)
@@ -144,6 +167,8 @@ def ring_attention(
             q_pos0=q_pos0 + idx * tq,
             shard_size=shard_size,
             axis_name=axis_name,
+            use_flash=use_flash,
+            interpret=interpret,
         )
 
     spec = P(None, axis_name, None, None)

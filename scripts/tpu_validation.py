@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""One-shot TPU validation sweep: run after hardware becomes reachable.
+
+Covers everything that cannot be validated on the CPU mesh: Pallas kernel
+numerics on real silicon, q40-vs-dense token parity, the ragged MoE kernel
+vs dense timing, and decode throughput at 1B/8B. Prints a summary table.
+
+    python scripts/tpu_validation.py            # full sweep
+    BENCH_QUICK=1 python scripts/tpu_validation.py   # smaller configs
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+from dllama_tpu.parallel.mesh import enable_compilation_cache, reassert_platform
+
+reassert_platform()
+enable_compilation_cache()
+
+import jax.numpy as jnp
+from jax import lax
+
+RESULTS: list[tuple[str, str]] = []
+
+
+def record(name: str, value: str):
+    RESULTS.append((name, value))
+    print(f"  {name}: {value}", flush=True)
+
+
+def sync(x):
+    return np.asarray(jax.device_get(jnp.ravel(x)[0]))
+
+
+def main() -> None:
+    quick = bool(os.environ.get("BENCH_QUICK"))
+    print(f"devices: {jax.devices()}", flush=True)
+
+    # 1. q40 pallas matmul numerics on silicon
+    from dllama_tpu.formats.quants import q40_to_planar, quantize_q40
+    from dllama_tpu.ops.quant_matmul import from_planar, qmatmul_2d, qmatmul_ref
+
+    rng = np.random.default_rng(0)
+    n, k = (1024, 4096) if quick else (4096, 8192)
+    w = rng.standard_normal((n, k)).astype(np.float32) * 0.05
+    qv, dv = q40_to_planar(quantize_q40(w), n * k)
+    qw = from_planar(qv.reshape(n, k), dv.reshape(n, k // 32))
+    x = jnp.asarray(rng.standard_normal((1, k)).astype(np.float32))
+    out = qmatmul_2d(x, qw.q, qw.d)
+    ref = qmatmul_ref(x.astype(jnp.bfloat16).astype(jnp.float32), qw)
+    rel = float(jnp.abs(out - ref).max() / jnp.abs(ref).max())
+    record("q40 kernel rel err", f"{rel:.2e} {'OK' if rel < 5e-3 else 'FAIL'}")
+
+    # 2. flash attention numerics on silicon
+    from dllama_tpu.ops.flash_attention import attention_ref, flash_attention
+
+    q = jnp.asarray(rng.standard_normal((1, 128, 8, 64)).astype(np.float32)).astype(jnp.bfloat16)
+    kc = jnp.asarray(rng.standard_normal((1, 1024, 4, 64)).astype(np.float32)).astype(jnp.bfloat16)
+    vc = jnp.asarray(rng.standard_normal((1, 1024, 4, 64)).astype(np.float32)).astype(jnp.bfloat16)
+    fo = flash_attention(q, kc, vc, jnp.int32(512))
+    fr = attention_ref(q, kc, vc, jnp.int32(512))
+    rel = float(
+        jnp.abs(fo.astype(jnp.float32) - fr.astype(jnp.float32)).max()
+    )
+    record("flash attn abs err (bf16)", f"{rel:.2e} {'OK' if rel < 2e-2 else 'FAIL'}")
+
+    # 3. ragged MoE kernel on silicon + timing vs dense
+    from dllama_tpu.ops.moe_kernel import moe_active_experts
+
+    E, D, F, K = (32, 1024, 512, 4) if quick else (128, 2048, 768, 8)
+    w1 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.05).astype(jnp.bfloat16)
+    w2 = jnp.asarray(rng.standard_normal((E, F, D)).astype(np.float32) * 0.05).astype(jnp.bfloat16)
+    w3 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.05).astype(jnp.bfloat16)
+    xm = jnp.asarray(rng.standard_normal((1, D)).astype(np.float32)).astype(jnp.bfloat16)
+    idx = jnp.asarray(rng.choice(E, K, replace=False).astype(np.int32))
+    wts = jnp.asarray(np.full(K, 1.0 / K, np.float32))
+    out = moe_active_experts(xm, w1, w2, w3, idx, wts)
+    # numpy oracle
+    xf = np.asarray(xm, np.float32)
+    exp = np.zeros((1, D), np.float32)
+    for i, e in enumerate(np.asarray(idx)):
+        h1 = xf @ np.asarray(w1[e], np.float32)
+        h3 = xf @ np.asarray(w3[e], np.float32)
+        exp += float(wts[i]) * ((h1 / (1 + np.exp(-h1)) * h3) @ np.asarray(w2[e], np.float32))
+    rel = float(np.abs(np.asarray(out) - exp).max() / (np.abs(exp).max() + 1e-9))
+    record("ragged moe rel err", f"{rel:.2e} {'OK' if rel < 5e-2 else 'FAIL'}")
+
+    def timeit(f, n_iter=50):
+        o = f()
+        sync(o)
+        t0 = time.perf_counter()
+        for _ in range(n_iter):
+            o = f()
+        sync(o)
+        return (time.perf_counter() - t0) / n_iter * 1000
+
+    t_ragged = timeit(lambda: moe_active_experts(xm, w1, w2, w3, idx, wts))
+    f_dense = jax.jit(
+        lambda xx: jnp.einsum("nd,edf->nef", xx, w1)
+    )
+    t_dense_w1 = timeit(lambda: f_dense(xm))
+    record("moe ragged (full swiglu k experts)", f"{t_ragged:.2f} ms")
+    record("moe dense (w1 only, all E)", f"{t_dense_w1:.2f} ms")
+
+    # 4. q40 vs dense greedy token parity through the engine (real silicon)
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+    from helpers import make_tiny_model
+
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    d = tempfile.mkdtemp()
+    cfg = dict(dim=128, hidden_dim=256, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=256, seq_len=128)
+    make_tiny_model(d + "/m.m", cfg=cfg)
+    eq = InferenceEngine(d + "/m.m", tp=1, dtype=jnp.bfloat16, temperature=0.0,
+                         weight_format="q40")
+    outq, _, _ = eq.generate([1, 2, 3, 4], max_steps=20)
+    del eq
+    ed = InferenceEngine(d + "/m.m", tp=1, dtype=jnp.bfloat16, temperature=0.0,
+                         weight_format="dense")
+    outd, _, _ = ed.generate([1, 2, 3, 4], max_steps=20)
+    del ed
+    record("engine q40 == dense tokens", "OK" if outq == outd else f"FAIL {outq} {outd}")
+
+    # 5. decode throughput
+    import subprocess
+
+    env = dict(os.environ)
+    for preset, fmt in (
+        [("llama-1b", "q40"), ("llama-1b", "dense"), ("llama-8b", "q40")]
+        if not quick
+        else [("llama-1b", "q40")]
+    ):
+        env.update(BENCH_PRESET=preset, BENCH_FORMAT=fmt, BENCH_STEPS="64")
+        try:
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(__file__), "..", "bench.py")],
+                capture_output=True, text=True, env=env, timeout=900,
+            )
+            if r.returncode != 0:
+                line = f"FAIL rc={r.returncode}: {r.stderr.strip().splitlines()[-1] if r.stderr.strip() else 'no stderr'}"
+            else:
+                line = (
+                    r.stdout.strip().splitlines()[-1]
+                    if r.stdout.strip()
+                    else "no output"
+                )
+        except subprocess.TimeoutExpired:
+            line = "FAIL: timeout (900s)"
+        record(f"bench {preset} {fmt}", line)
+
+    print("\n=== TPU validation summary ===")
+    for name, value in RESULTS:
+        print(f"{name:40s} {value}")
+
+
+if __name__ == "__main__":
+    main()

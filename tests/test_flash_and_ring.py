@@ -103,3 +103,38 @@ def test_moe_pallas_tp_branch_matches_dense():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(dense), rtol=1e-4, atol=1e-4
     )
+
+
+def test_flash_stats_matches_jnp_stats():
+    """Pallas flash-stats kernel vs the shared jnp partial-state math,
+    across query/key offsets (normalized output + log-sum-exp invariants)."""
+    from dllama_tpu.ops.flash_attention import flash_attention_stats
+    from dllama_tpu.ops.jnp_ops import attention_stats
+
+    q, k, v = make_qkv(1, 16, 4, 2, 16, 32, seed=5)
+    for qp, sp in [(0, 0), (16, 0), (0, 16), (40, 16)]:
+        acc, m, l = flash_attention_stats(
+            q, k, v, jnp.int32(qp), jnp.int32(sp),
+            block_t=8, block_s=8, interpret=True,
+        )
+        acc_r, m_r, l_r = attention_stats(q, k, v, jnp.int32(qp), jnp.int32(sp))
+        mask = np.asarray(l_r) > 0
+        o = np.asarray(acc) / np.maximum(np.asarray(l)[..., None], 1e-30)
+        o_r = np.asarray(acc_r) / np.maximum(np.asarray(l_r)[..., None], 1e-30)
+        np.testing.assert_allclose(o[mask], o_r[mask], rtol=1e-5, atol=1e-5)
+        lse = np.asarray(m) + np.log(np.maximum(np.asarray(l), 1e-30))
+        lse_r = np.asarray(m_r) + np.log(np.maximum(np.asarray(l_r), 1e-30))
+        np.testing.assert_allclose(lse[mask], lse_r[mask], rtol=1e-5, atol=1e-5)
+
+
+def test_ring_with_flash_local_step():
+    """Ring attention using the Pallas flash-stats local step (interpret)
+    must equal the single-device reference."""
+    b, t, h, kh, hd = 1, 32, 4, 2, 16
+    q, k, v = make_qkv(b, t, h, kh, hd, t, seed=19)
+    mesh = make_mesh(sp=4)
+    expected = attention_ref(q, k, v, jnp.int32(0))
+    out = ring_attention(q, k, v, mesh, q_pos0=0, use_flash=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=2e-4, atol=2e-4
+    )
