@@ -274,6 +274,7 @@ def forward(
     cache: KvCache,
     mesh=None,
     moe_gather_max_tokens: int = 0,
+    attn_window: int = 0,
 ) -> Tuple[jnp.ndarray, KvCache]:
     """Run the decoder on T tokens starting at absolute position `pos`.
 
@@ -284,6 +285,11 @@ def forward(
     `mesh` is only consulted by the quantized (Pallas) matmul path, which
     needs explicit shard_map partitioning; the dense path is GSPMD-managed
     and ignores it.
+
+    `attn_window` (static) restricts attention reads to the first
+    `attn_window` cache rows — the caller guarantees pos + T <= window.
+    On a 128k-seq-len model decoding at position 1k this cuts per-step
+    cache reads by 128x; cache writes still land in the full-length cache.
     """
     b, t = tokens.shape
     interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
@@ -318,7 +324,12 @@ def forward(
             v_cache_l, v.astype(v_cache_l.dtype), pos, axis=1
         )
 
-        z = _attention_tp(q, k_cache_l, v_cache_l, pos, h.head_dim, mesh)
+        if attn_window and attn_window < k_cache_l.shape[1]:
+            k_view = k_cache_l[:, :attn_window]
+            v_view = v_cache_l[:, :attn_window]
+        else:
+            k_view, v_view = k_cache_l, v_cache_l
+        z = _attention_tp(q, k_view, v_view, pos, h.head_dim, mesh)
         x = x + _mm(z, lp["wo"], "col", mesh).astype(x.dtype)
 
         # -- FFN block (reference: src/llm.cpp:405-557) --

@@ -170,9 +170,24 @@ class InferenceEngine:
 
     # -- compiled steps ------------------------------------------------------
 
-    def _step_fn(self, t: int, greedy: bool):
+    def _attn_window(self, limit: int) -> int:
+        """Smallest power-of-2 window >= limit (min 512) covering the live
+        cache prefix; full seq_len when nothing smaller fits. One compiled
+        program per window keeps decode reads proportional to the context
+        actually used instead of the allocated seq_len."""
+        s = self.header.seq_len
+        w = 512
+        while w < limit:
+            w *= 2
+        # NB: crossing a window boundary mid-generation compiles a fresh
+        # program for the next window (one synchronous stall per crossing,
+        # log2(seq_len/512) of them worst case); pre-warming the next
+        # window asynchronously is a known follow-up (ROADMAP.md)
+        return min(w, s)
+
+    def _step_fn(self, t: int, greedy: bool, window: int = 0):
         """Build/jit the forward step for chunk length `t`."""
-        key = (t, greedy)
+        key = (t, greedy, window)
         if key in self._compiled:
             return self._compiled[key]
         h = self.header
@@ -188,7 +203,9 @@ class InferenceEngine:
                 else contextlib.nullcontext()
             )
             with ctx:
-                logits, cache = forward(params, h, tokens, pos, cache, mesh=mesh)
+                logits, cache = forward(
+                    params, h, tokens, pos, cache, mesh=mesh, attn_window=window
+                )
             last = logits[:, -1, :]
             if greedy:
                 # On-device sampling (reference samples on host from the
@@ -200,7 +217,7 @@ class InferenceEngine:
         self._compiled[key] = step
         return step
 
-    def _decode_block_fn(self, n_steps: int, greedy: bool):
+    def _decode_block_fn(self, n_steps: int, greedy: bool, window: int = 0):
         """Jitted on-device decode of `n_steps` tokens: the sample ->
         feed-back loop runs under `lax.fori_loop`, so the host pays one
         dispatch per block instead of one per token (host->device dispatch
@@ -208,7 +225,7 @@ class InferenceEngine:
         lax.fori_loop multi-step plan from SURVEY.md §7 hard parts).
         Sampling (temperature/top-p) runs on device too; temp/topp are
         traced so changing them does not recompile."""
-        key = ("block", n_steps, greedy)
+        key = ("block", n_steps, greedy, window)
         if key in self._compiled:
             return self._compiled[key]
         h = self.header
@@ -226,7 +243,8 @@ class InferenceEngine:
                 )
                 with ctx:
                     logits, cache = forward(
-                        params, h, tok, pos + i, cache, mesh=mesh
+                        params, h, tok, pos + i, cache, mesh=mesh,
+                        attn_window=window,
                     )
                 last = logits[:, -1, :]
                 if greedy:
@@ -270,7 +288,8 @@ class InferenceEngine:
             arr = jnp.asarray([[token]] * self.batch_size, dtype=jnp.int32)
         arr = jax.device_put(arr, self._token_sharding)
         greedy = self.temperature == 0.0
-        block = self._decode_block_fn(n_steps, greedy)
+        window = self._attn_window(pos + n_steps)
+        block = self._decode_block_fn(n_steps, greedy, window)
         # fold in a call counter so successive generations differ (the
         # reference's xorshift state advances across calls the same way)
         self._rng_calls += 1
@@ -339,7 +358,9 @@ class InferenceEngine:
             fills = [fill[width:] for fill in fills]
             arr = jnp.asarray(padded, dtype=jnp.int32)
             arr = jax.device_put(arr, self._token_sharding)
-            step = self._step_fn(bucket, greedy=False)
+            step = self._step_fn(
+                bucket, greedy=False, window=self._attn_window(p + bucket)
+            )
             t0 = time.perf_counter()
             # Padding tokens write garbage into cache slots [p+width,
             # p+bucket) — harmless: the causal mask hides them until real
@@ -370,7 +391,7 @@ class InferenceEngine:
         arr = jnp.asarray([[token]] * self.batch_size, dtype=jnp.int32)
         arr = jax.device_put(arr, self._token_sharding)
         greedy = self.temperature == 0.0
-        step = self._step_fn(1, greedy=greedy)
+        step = self._step_fn(1, greedy=greedy, window=self._attn_window(pos + 1))
         t0 = time.perf_counter()
         out, self.cache = step(self.params, arr, self.cache, jnp.int32(pos))
         out = jax.block_until_ready(out)

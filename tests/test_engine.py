@@ -230,3 +230,33 @@ def test_generate_batch_lanes_independent(tiny_model):
     ref2, _, _ = e1.generate(p2, max_steps=14)
     assert outs[0] == ref1
     assert outs[1] == ref2
+
+
+def test_attn_window_equivalence(tmp_path):
+    """Windowed attention (power-of-2 cache prefix) must reproduce the
+    full-cache tokens on a long-seq-len model decoded at short positions."""
+    mp = str(tmp_path / "w.m")
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=288, seq_len=2048)
+    make_tiny_model(mp, weight_type=FloatType.F32, cfg=cfg)
+    e = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    assert e._attn_window(5) == 512          # min window
+    assert e._attn_window(600) == 1024       # next pow2
+    assert e._attn_window(1500) == 2048      # clamped to seq_len
+    out_windowed, _, _ = e.generate([1, 2, 3, 4], max_steps=16)
+
+    # force full-cache windows and compare
+    e2 = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    e2._attn_window = lambda limit: cfg["seq_len"]
+    out_full, _, _ = e2.generate([1, 2, 3, 4], max_steps=16)
+    assert out_windowed == out_full
+
+    # cross the 512 -> 1024 window boundary mid-generation (the risky edge:
+    # window growth + recompile must not drop live cache rows)
+    prompt = list(range(1, 509))
+    e.reset()
+    out_cross, _, _ = e.generate(prompt, max_steps=530)
+    e2.reset()
+    out_cross_full, _, _ = e2.generate(prompt, max_steps=530)
+    assert out_cross == out_cross_full
+    assert len(out_cross) == 530 - 508
