@@ -259,4 +259,4 @@ def test_attn_window_equivalence(tmp_path):
     e2.reset()
     out_cross_full, _, _ = e2.generate(prompt, max_steps=530)
     assert out_cross == out_cross_full
-    assert len(out_cross) == 530 - 508
+    assert len(out_cross) == 530 - (len(prompt) - 1)
