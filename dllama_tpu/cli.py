@@ -40,7 +40,10 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--workers", nargs="*", default=None, help="alias for --tp: pass a chip count (host:port lists are a LAN-cluster concept)")
     p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--kv-dtype", default=None, choices=[None, "bf16", "f32"])
-    p.add_argument("--chat-template", default=None, choices=[None, "llama2", "llama3", "deepSeek3", "chatml"])
+    from .tokenizer import CHAT_TEMPLATE_NAMES
+
+    p.add_argument("--chat-template", default=None,
+                   choices=[None, *CHAT_TEMPLATE_NAMES])
     p.add_argument("--gpu-index", type=int, default=None)
     p.add_argument("--gpu-segments", default=None)
     p.add_argument("--weight-format", default="auto", choices=["auto", "q40", "dense"],
@@ -220,14 +223,13 @@ def run_chat(args) -> None:
         if tok.eos_token_ids
         else ""
     )
-    ttype = ChatTemplateType.UNKNOWN
-    if args.chat_template:
-        ttype = {
-            "llama2": ChatTemplateType.LLAMA2,
-            "llama3": ChatTemplateType.LLAMA3,
-            "deepSeek3": ChatTemplateType.DEEP_SEEK3,
-            "chatml": ChatTemplateType.CHATML,
-        }[args.chat_template]
+    from .tokenizer import CHAT_TEMPLATE_NAMES
+
+    ttype = (
+        CHAT_TEMPLATE_NAMES[args.chat_template]
+        if args.chat_template
+        else ChatTemplateType.UNKNOWN
+    )
     gen = ChatTemplateGenerator(ttype, tok.chat_template, eos_piece)
     stops = [tok.vocab[t].decode("utf-8", "replace") for t in tok.eos_token_ids]
     pos = 0

@@ -444,12 +444,20 @@ def main(argv=None) -> None:
         engine = None
         try:
             engine, tok = load_engine(args)
+            from ..tokenizer import CHAT_TEMPLATE_NAMES
+
+            ttype = (
+                CHAT_TEMPLATE_NAMES[args.chat_template]
+                if args.chat_template
+                else ChatTemplateType.UNKNOWN
+            )
             server = serve(
                 engine,
                 tok,
                 host=args.host,
                 port=args.port,
                 model_name=os.path.basename(args.model),
+                chat_template_type=ttype,
             )
             server.serve_forever()
             return

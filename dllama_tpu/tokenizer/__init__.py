@@ -1,5 +1,6 @@
 from .bpe import Tokenizer
 from .chat import (
+    CHAT_TEMPLATE_NAMES,
     ChatItem,
     ChatTemplateType,
     ChatTemplateGenerator,
@@ -9,6 +10,7 @@ from .chat import (
 
 __all__ = [
     "Tokenizer",
+    "CHAT_TEMPLATE_NAMES",
     "ChatItem",
     "ChatTemplateType",
     "ChatTemplateGenerator",

@@ -20,6 +20,16 @@ class ChatTemplateType(enum.IntEnum):
     CHATML = 4
 
 
+# CLI names (reference: parseChatTemplateType, src/app.cpp); the argparse
+# choices and every name->type lookup derive from this single map
+CHAT_TEMPLATE_NAMES = {
+    "llama2": ChatTemplateType.LLAMA2,
+    "llama3": ChatTemplateType.LLAMA3,
+    "deepSeek3": ChatTemplateType.DEEP_SEEK3,
+    "chatml": ChatTemplateType.CHATML,
+}
+
+
 @dataclasses.dataclass
 class ChatItem:
     role: str

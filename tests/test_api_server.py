@@ -199,3 +199,66 @@ def test_stream_error_still_terminates(server):
         raw = r.read().decode()
     assert '"error"' in raw
     assert raw.rstrip().endswith("data: [DONE]")
+
+
+def test_api_main_chat_template_flag(tmp_path):
+    """--chat-template forces the template type even when the tokenizer
+    carries a different/absent jinja template."""
+    import subprocess
+    import sys
+    import os as _os
+    from helpers import REPO_ROOT, make_tiny_model, make_tiny_tokenizer
+
+    mp = str(tmp_path / "m.m")
+    tp = str(tmp_path / "t.t")
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=288, seq_len=384)
+    make_tiny_model(mp, cfg=cfg)
+    make_tiny_tokenizer(tp, pad_to=288)  # no chat template in the file
+    import socket
+
+    with socket.socket() as s0:
+        s0.bind(("127.0.0.1", 0))
+        port = s0.getsockname()[1]
+    log_path = tmp_path / "server.log"
+    with open(log_path, "wb") as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dllama_tpu.runtime.api_server",
+             "--model", mp, "--tokenizer", tp, "--port", str(port),
+             "--host", "127.0.0.1", "--tp", "1", "--dtype", "f32",
+             "--temperature", "0", "--chat-template", "chatml"],
+            env={**_os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=REPO_ROOT,
+            stdout=log, stderr=subprocess.STDOUT,
+        )
+    try:
+        import time as _t
+        import urllib.request
+
+        deadline = _t.time() + 120
+        while _t.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"server exited rc={proc.returncode}:\n"
+                    + log_path.read_text()[-1000:]
+                )
+            try:
+                urllib.request.urlopen(f"http://127.0.0.1:{port}/health", timeout=2)
+                break
+            except Exception:
+                _t.sleep(1)
+        else:
+            raise AssertionError(
+                "server did not come up:\n" + log_path.read_text()[-1000:]
+            )
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            data=json.dumps({"messages": [{"role": "user", "content": "hi"}],
+                             "max_tokens": 3, "temperature": 0}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        data = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        assert data["object"] == "chat.completion"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
