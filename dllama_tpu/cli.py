@@ -215,7 +215,14 @@ def run_inference(args) -> None:
 
 def run_chat(args) -> None:
     """Interactive REPL (reference: dllama.cpp:174-258)."""
-    from .tokenizer import ChatItem, ChatTemplateGenerator, ChatTemplateType, EosDetector, EosResult
+    from .tokenizer import (
+        CHAT_TEMPLATE_NAMES,
+        ChatItem,
+        ChatTemplateGenerator,
+        ChatTemplateType,
+        EosDetector,
+        EosResult,
+    )
 
     engine, tok = load_engine(args)
     eos_piece = (
@@ -223,8 +230,6 @@ def run_chat(args) -> None:
         if tok.eos_token_ids
         else ""
     )
-    from .tokenizer import CHAT_TEMPLATE_NAMES
-
     ttype = (
         CHAT_TEMPLATE_NAMES[args.chat_template]
         if args.chat_template

@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..tokenizer import (
+    CHAT_TEMPLATE_NAMES,
     ChatItem,
     ChatTemplateGenerator,
     ChatTemplateType,
@@ -444,8 +445,6 @@ def main(argv=None) -> None:
         engine = None
         try:
             engine, tok = load_engine(args)
-            from ..tokenizer import CHAT_TEMPLATE_NAMES
-
             ttype = (
                 CHAT_TEMPLATE_NAMES[args.chat_template]
                 if args.chat_template
