@@ -73,3 +73,10 @@ def test_loader_uses_native_path(tmp_path, lib):
     np.testing.assert_array_equal(
         np.asarray(p_native["wcls"].q), np.asarray(p_numpy["wcls"].q)
     )
+
+
+def test_f32_transpose_parity(lib):
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((130, 257)).astype(np.float32)  # odd sizes
+    out = native.f32_transpose(a)
+    np.testing.assert_array_equal(out, a.T)
