@@ -51,8 +51,10 @@ def load_params(
     `weight_format="q40"` keeps the matmul weights block-quantized on
     device as `QuantWeight` (int8 values + f32 scales, the Pallas kernel's
     layout) instead of dequantizing — ~3.6x less HBM traffic per decode
-    step. Requires a Q40 file; the MoE expert weights currently stay dense
-    (the ragged quantized MoE kernel is future work, SURVEY.md §7).
+    step. Requires a Q40 file. MoE expert weights are kept quantized too
+    (the ragged kernel dequantizes selected blocks in VMEM), so a Q40 MoE
+    file's device footprint stays ~1.125 B/weight instead of blowing up to
+    bf16 density.
     """
     h = reader.header
     quantize = weight_format == "q40"
@@ -86,22 +88,23 @@ def load_params(
     def stack(fn: Callable[[int], np.ndarray]) -> np.ndarray:
         return np.stack([fn(l) for l in range(h.n_layers)])
 
+    def unpack_q40(name: str) -> tuple[np.ndarray, np.ndarray]:
+        """Q40 tensor -> (q int8 [in, out], d f32 [in//32, out]) device
+        layout; native C++ unpack when built (one multithreaded pass),
+        numpy fallback otherwise."""
+        out_dim, in_dim = reader.by_name[name].shape
+        unpacked = native.q40_unpack_transposed(reader.raw(name), out_dim, in_dim)
+        if unpacked is None:
+            unpacked = planar_to_device_layout(*reader.planar_q40(name))
+        return unpacked
+
     def qw(tag: str, fn: Callable[[int], str]):
-        """Stacked QuantWeight for a per-layer matmul tensor (native C++
-        unpack when built — one multithreaded pass straight into the
-        device layout; numpy fallback otherwise)."""
+        """Stacked QuantWeight for a per-layer matmul tensor."""
         qs, ds = [], []
         for l in range(h.n_layers):
-            name = fn(l)
-            spec = reader.by_name[name]
-            out_dim, in_dim = spec.shape
-            unpacked = native.q40_unpack_transposed(
-                reader.raw(name), out_dim, in_dim
-            )
-            if unpacked is None:
-                unpacked = planar_to_device_layout(*reader.planar_q40(name))
-            qs.append(unpacked[0])
-            ds.append(unpacked[1])
+            q_arr, d_arr = unpack_q40(fn(l))
+            qs.append(q_arr)
+            ds.append(d_arr)
         return QuantWeight(put(tag, np.stack(qs)), put(tag, np.stack(ds)))
 
     layers: dict[str, jnp.ndarray] = {}
@@ -127,14 +130,37 @@ def load_params(
             "moe_gate", stack(lambda l: w(f"layers.{l}.moe_gate"))
         )
 
-        def experts(l: int, which: str) -> np.ndarray:
-            return np.stack(
-                [w(f"layers.{l}.experts.{e}.{which}") for e in range(h.n_experts)]
-            )
+        if quantize:
+            # Experts stay block-quantized on device (the reference stores
+            # and ships experts Q40 too: src/llm.cpp:425-499,
+            # src/nn/nn-network.cpp:856-888); the ragged MoE kernel
+            # dequantizes selected blocks in VMEM. Layout per expert is the
+            # same [in, out] device layout as the dense matmuls, stacked
+            # [L, E, ...].
+            def qexperts(tag: str, which: str) -> QuantWeight:
+                lqs, lds = [], []
+                for l in range(h.n_layers):
+                    unpacked = [
+                        unpack_q40(f"layers.{l}.experts.{e}.{which}")
+                        for e in range(h.n_experts)
+                    ]
+                    lqs.append(np.stack([u[0] for u in unpacked]))
+                    lds.append(np.stack([u[1] for u in unpacked]))
+                return QuantWeight(put(tag, np.stack(lqs)), put(tag, np.stack(lds)))
 
-        layers["w1"] = put("w1", stack(lambda l: experts(l, "w1")).astype(dtype))
-        layers["w2"] = put("w2", stack(lambda l: experts(l, "w2")).astype(dtype))
-        layers["w3"] = put("w3", stack(lambda l: experts(l, "w3")).astype(dtype))
+            layers["w1"] = qexperts("w1", "w1")
+            layers["w2"] = qexperts("w2", "w2")
+            layers["w3"] = qexperts("w3", "w3")
+        else:
+
+            def experts(l: int, which: str) -> np.ndarray:
+                return np.stack(
+                    [w(f"layers.{l}.experts.{e}.{which}") for e in range(h.n_experts)]
+                )
+
+            layers["w1"] = put("w1", stack(lambda l: experts(l, "w1")).astype(dtype))
+            layers["w2"] = put("w2", stack(lambda l: experts(l, "w2")).astype(dtype))
+            layers["w3"] = put("w3", stack(lambda l: experts(l, "w3")).astype(dtype))
     elif quantize:
         layers["w1"] = qw("w1", lambda l: f"layers.{l}.w1")
         layers["w2"] = qw("w2", lambda l: f"layers.{l}.w2")
@@ -154,13 +180,8 @@ def load_params(
 
     cos, sin = rope_cache(h)
     if quantize:
-        spec = reader.by_name["wcls"]
-        unpacked = native.q40_unpack_transposed(
-            reader.raw("wcls"), spec.shape[0], spec.shape[1]
-        )
-        if unpacked is None:
-            unpacked = planar_to_device_layout(*reader.planar_q40("wcls"))
-        wcls = QuantWeight(put("wcls", unpacked[0]), put("wcls", unpacked[1]))
+        q_arr, d_arr = unpack_q40("wcls")
+        wcls = QuantWeight(put("wcls", q_arr), put("wcls", d_arr))
     else:
         wcls = put("wcls", w("wcls").astype(dtype))
     params: Params = {

@@ -31,9 +31,9 @@ from jax import lax
 
 from ..formats.model_file import HiddenAct, LlmArch, LlmHeader, RopeType
 from ..ops.jnp_ops import apply_rope, gelu, qk_rms_norm, rms_norm, silu
-from ..ops.quant_matmul import QuantWeight, qmatmul_tp
+from ..ops.quant_matmul import QuantWeight, dequant, qmatmul_tp
 from ..ops.flash_attention import flash_attention, pick_flash_blocks
-from ..ops.moe_kernel import moe_active_experts
+from ..ops.moe_kernel import moe_active_experts, moe_active_experts_q40
 
 Params = Dict[str, Any]
 KvCache = Dict[str, jnp.ndarray]
@@ -155,9 +155,14 @@ def _moe_ffn(
 
     Routing is dense over experts (every expert computes, outputs are
     masked by routing weight). That is compile-friendly and exact; the
-    gather/ragged fast path for decode lives in the engine's step function
-    once the Pallas ragged kernel lands (SURVEY.md §7 hard parts).
+    gather/ragged fast path for decode is `_moe_ffn_pallas`.
+
+    Quantized expert weights (QuantWeight) are dequantized on the fly —
+    one layer's experts at a time under the scan, so the transient is one
+    [E, D, F] bf16 tensor, never the whole stack.
     """
+    if isinstance(w1, QuantWeight):
+        w1, w2, w3 = (dequant(w, x.dtype) for w in (w1, w2, w3))
     e = gate_w.shape[1]
     top_i, weights = _moe_route(x, gate_w, n_active)  # [B, T, k]
 
@@ -200,13 +205,25 @@ def _moe_ffn_gather(
     xf = x.reshape(n, d)
     top_i, weights = _moe_route(xf, gate_w, n_active)  # [n, k]
 
-    w1_sel = jnp.take(w1, top_i.reshape(-1), axis=0)  # [n*k, D, F]
-    w3_sel = jnp.take(w3, top_i.reshape(-1), axis=0)
-    w2_sel = jnp.take(w2, top_i.reshape(-1), axis=0)  # [n*k, F, D]
+    if isinstance(w1, QuantWeight):
+        flat = top_i.reshape(-1)
+        w1_sel, w2_sel, w3_sel = (
+            dequant(
+                QuantWeight(
+                    jnp.take(w.q, flat, axis=0), jnp.take(w.d, flat, axis=0)
+                ),
+                x.dtype,
+            )
+            for w in (w1, w2, w3)
+        )
+    else:
+        w1_sel = jnp.take(w1, top_i.reshape(-1), axis=0)  # [n*k, D, F]
+        w3_sel = jnp.take(w3, top_i.reshape(-1), axis=0)
+        w2_sel = jnp.take(w2, top_i.reshape(-1), axis=0)  # [n*k, F, D]
     k = n_active
-    w1_sel = w1_sel.reshape(n, k, *w1.shape[1:])
-    w3_sel = w3_sel.reshape(n, k, *w3.shape[1:])
-    w2_sel = w2_sel.reshape(n, k, *w2.shape[1:])
+    w1_sel = w1_sel.reshape(n, k, *w1_sel.shape[1:])
+    w3_sel = w3_sel.reshape(n, k, *w3_sel.shape[1:])
+    w2_sel = w2_sel.reshape(n, k, *w2_sel.shape[1:])
 
     hidden = act(jnp.einsum("nd,nkdf->nkf", xf, w1_sel))
     hidden = hidden * jnp.einsum("nd,nkdf->nkf", xf, w3_sel).astype(hidden.dtype)
@@ -217,52 +234,79 @@ def _moe_ffn_gather(
     return out.reshape(b, t, d).astype(x.dtype)
 
 
+# Largest B*T routed through the ragged Pallas kernel: decode-lane sized.
+# Beyond this, dense all-expert compute wins back (at m*k approaching E the
+# per-(token, choice) DMA schedule re-reads experts the dense path reads
+# once).
+MOE_PALLAS_MAX_TOKENS = 16
+
+
 def _moe_ffn_pallas(
-    x: jnp.ndarray,  # [B, T, D] with B*T == 1
+    x: jnp.ndarray,  # [B, T, D] with B*T <= MOE_PALLAS_MAX_TOKENS
     gate_w: jnp.ndarray,
-    w1: jnp.ndarray,  # [E, D, F]
-    w2: jnp.ndarray,  # [E, F, D]
-    w3: jnp.ndarray,  # [E, D, F]
+    w1,  # [E, D, F] dense, or QuantWeight (q int8 [E, D, F] + d [E, D/32, F])
+    w2,  # [E, F, D] (same)
+    w3,  # [E, D, F] (same)
     n_active: int,
     mesh,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Decode-step MoE via the ragged Pallas kernel (ops/moe_kernel.py):
-    the top-k expert ids drive the HBM->VMEM DMA schedule, so only active
-    experts' weights are read. TP: experts are hidden-dim sliced like the
-    reference (w1/w3 row-split, w2 col-split, llm.cpp:450-487), so each
-    shard computes its slice and the partial outputs psum over ICI."""
+    each token's top-k expert ids drive the HBM->VMEM DMA schedule, so only
+    active experts' weights are read — quantized blocks when the experts
+    are stored Q40 (the reference's storage format, src/llm.cpp:425-499).
+    TP: experts are hidden-dim sliced like the reference (w1/w3 row-split,
+    w2 col-split, llm.cpp:450-487), so each shard computes its slice and
+    the partial outputs psum over ICI; tokens (the engine's dp lanes) stay
+    dp-sharded."""
     b, t, d = x.shape
-    xf = x.reshape(1, d)
-    top_i, weights = _moe_route(xf, gate_w, n_active)
-    top_i, weights = top_i[0], weights[0]
+    n = b * t
+    xf = x.reshape(n, d)
+    top_i, weights = _moe_route(xf, gate_w, n_active)  # [n, k]
+    quantized = isinstance(w1, QuantWeight)
+
+    if quantized:
+        operands = (xf, w1.q, w1.d, w2.q, w2.d, w3.q, w3.d, top_i, weights)
+
+        def run(xx, w1q, w1d, w2q, w2d, w3q, w3d, ii, wts):
+            return moe_active_experts_q40(
+                xx, w1q, w1d, w2q, w2d, w3q, w3d, ii, wts, interpret=interpret
+            )
+
+    else:
+        operands = (xf, w1, w2, w3, top_i, weights)
+
+        def run(xx, ww1, ww2, ww3, ii, wts):
+            return moe_active_experts(
+                xx, ww1, ww2, ww3, ii, wts, interpret=interpret
+            )
 
     if mesh is None or mesh.devices.size == 1:
-        out = moe_active_experts(xf, w1, w2, w3, top_i, weights, interpret=interpret)
+        out = run(*operands)
     else:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        def body(xx, ww1, ww2, ww3, ii, wts):
-            return lax.psum(
-                moe_active_experts(xx, ww1, ww2, ww3, ii, wts, interpret=interpret),
-                "tp",
-            )
+        # tokens ride the dp axis (xf's flat axis folds in the dp-sharded
+        # batch); expert weights ride tp exactly like the dense FFN
+        tok = P("dp", None) if (n % mesh.shape.get("dp", 1) == 0 and n > 1) else P()
+        row_q = P(None, None, "tp")  # w1/w3 values AND scales: F on lanes
+        col_q = P(None, "tp", None)  # w2 values AND scales: F on sublanes
+        if quantized:
+            in_specs = (tok, row_q, row_q, col_q, col_q, row_q, row_q, tok, tok)
+        else:
+            in_specs = (tok, row_q, col_q, row_q, tok, tok)
+
+        def body(*args):
+            return lax.psum(run(*args), "tp")
 
         out = shard_map(
             body,
             mesh=mesh,
-            in_specs=(
-                P(),
-                P(None, None, "tp"),
-                P(None, "tp", None),
-                P(None, None, "tp"),
-                P(),
-                P(),
-            ),
-            out_specs=P(),
+            in_specs=in_specs,
+            out_specs=tok,
             check_vma=False,
-        )(xf, w1, w2, w3, top_i, weights)
+        )(*operands)
     return out.reshape(b, t, d).astype(x.dtype)
 
 
@@ -335,12 +379,14 @@ def forward(
         # -- FFN block (reference: src/llm.cpp:405-557) --
         y = rms_norm(x, lp["ffn_norm"], h.norm_epsilon)
         if h.arch == LlmArch.QWEN3_MOE:
-            # decode (one token): the ragged Pallas kernel reads only the
-            # active experts' weights. Prefill / CPU: dense-over-experts
-            # (XLA's jnp.take gather measured ~3x slower than even dense,
-            # so the gather path stays opt-in via moe_gather_max_tokens).
+            # decode (lane-sized B*T): the ragged Pallas kernel reads only
+            # each token's active experts' weights — Q40 blocks when the
+            # experts are stored quantized. Prefill / CPU: dense-over-
+            # experts (XLA's jnp.take gather measured ~3x slower than even
+            # dense, so the gather path stays opt-in via
+            # moe_gather_max_tokens).
             if (
-                b * t == 1
+                b * t <= MOE_PALLAS_MAX_TOKENS
                 and h.hidden_act == HiddenAct.SILU
                 and jax.default_backend() == "tpu"
             ):

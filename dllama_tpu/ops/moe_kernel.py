@@ -1,4 +1,4 @@
-"""Ragged MoE dispatch: Pallas kernel computing ONLY the active experts.
+"""Ragged MoE dispatch: Pallas kernels computing ONLY the active experts.
 
 The decode-path answer to SURVEY.md §7's "MoE top-k on TPU with tiny active
 expert counts (A3B: 8 of 128) without wasting a dense 128-expert matmul".
@@ -8,15 +8,26 @@ restatement (`jnp.take` of the expert weights) measures ~3x slower than
 even the dense all-expert einsum on v5e, because the gather materializes
 the selected weights through HBM.
 
-This kernel instead makes the expert id part of the DMA schedule: the
+These kernels instead make the expert id part of the DMA schedule: the
 top-k indices arrive via scalar prefetch and the BlockSpec index_map picks
 which expert's weight tile to copy HBM->VMEM per grid step — the selected
-expert weights are read exactly once, nothing else moves.
+expert weights are read exactly once per (token, choice), nothing else
+moves.
 
-Grid: (k,) active experts, one SwiGLU expert pipeline per step, output
-accumulated in VMEM scratch weighted by the routing probabilities.
-Decode-sized (B*T small); prefill keeps the dense path where every expert
-is busy anyway.
+Grid: (m, k) — token-major, active experts innermost; one SwiGLU expert
+pipeline per step, accumulated into a VMEM scratch row weighted by the
+routing probability. Routing is PER TOKEN (each decode lane picks its own
+top-k, matching the reference's per-row indexes buffer). Decode-sized
+m (the engine's dp lanes); prefill keeps the dense path where every
+expert is busy anyway.
+
+Two variants:
+- `moe_active_experts`: dense bf16/f32 expert weights.
+- `moe_active_experts_q40`: block-quantized experts (int8 values +
+  per-32-block f32 scales, the `QuantWeight` device layout) dequantized
+  in-VMEM after the DMA, exactly like ops/quant_matmul._qmm_kernel — the
+  reference stores experts Q40 too (src/llm.cpp:425-499) and ships Q40
+  slices per expert (src/nn/nn-network.cpp:856-888).
 """
 
 from __future__ import annotations
@@ -28,44 +39,96 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+Q_BLOCK = 32
 
-def _moe_kernel(
-    idx_ref,  # scalar prefetch: [k] int32 expert ids
-    w_ref,  # scalar prefetch: [k] f32 routing weights (SMEM)
-    x_ref,  # [m, D]
-    w1_ref,  # [1, D, F] (selected expert)
-    w3_ref,  # [1, D, F]
-    w2_ref,  # [1, F, D]
-    o_ref,  # [m, D]
-    acc_ref,  # VMEM [m, D] f32
-    *,
-    n_k: int,
-):
-    i = pl.program_id(0)
 
-    @pl.when(i == 0)
+def _swiglu_accum(x, w1, w3, w2, routing_w, ki, n_k, acc_ref, o_ref):
+    """Shared kernel tail: SwiGLU through one expert's weights, weighted
+    accumulation in VMEM scratch, emit on the last active expert."""
+
+    @pl.when(ki == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    x = x_ref[:]  # [m, D]
     h1 = jax.lax.dot_general(
-        x, w1_ref[0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        x, w1, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
     h3 = jax.lax.dot_general(
-        x, w3_ref[0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        x, w3, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
     hidden = (h1 / (1.0 + jnp.exp(-h1))) * h3  # silu(w1 x) * (w3 x), f32
     out = jax.lax.dot_general(
-        hidden.astype(x.dtype), w2_ref[0], (((1,), (0,)), ((), ())),
+        hidden.astype(x.dtype), w2,
+        (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    acc_ref[:] += out * w_ref[i]
+    acc_ref[:] += out * routing_w
 
-    @pl.when(i == n_k - 1)
+    @pl.when(ki == n_k - 1)
     def _emit():
         o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def _moe_kernel(
+    idx_ref,  # scalar prefetch: [m, k] int32 expert ids
+    w_ref,  # scalar prefetch: [m, k] f32 routing weights (SMEM)
+    x_ref,  # [1, D] (this token's row)
+    w1_ref,  # [1, D, F] (selected expert)
+    w3_ref,  # [1, D, F]
+    w2_ref,  # [1, F, D]
+    o_ref,  # [1, D]
+    acc_ref,  # VMEM [1, D] f32
+    *,
+    n_k: int,
+):
+    ti, ki = pl.program_id(0), pl.program_id(1)
+    _swiglu_accum(
+        x_ref[:], w1_ref[0], w3_ref[0], w2_ref[0],
+        w_ref[ti, ki], ki, n_k, acc_ref, o_ref,
+    )
+
+
+def _dequant_block(q, d):
+    """In-VMEM Q40 dequant: q int8 [I, O], d f32 [I // 32, O] -> bf16 [I, O]
+    (sublane-broadcast multiply; same move as quant_matmul._qmm_kernel)."""
+    i, o = q.shape
+    return (
+        (q.astype(jnp.float32).reshape(i // Q_BLOCK, Q_BLOCK, o) * d[:, None, :])
+        .reshape(i, o)
+        .astype(jnp.bfloat16)
+    )
+
+
+def _moe_kernel_q40(
+    idx_ref,  # scalar prefetch: [m, k] int32 expert ids
+    w_ref,  # scalar prefetch: [m, k] f32 routing weights
+    x_ref,  # [1, D]
+    w1q_ref,  # [1, D, F] int8
+    w1d_ref,  # [1, D // 32, F] f32
+    w3q_ref,  # [1, D, F] int8
+    w3d_ref,  # [1, D // 32, F] f32
+    w2q_ref,  # [1, F, D] int8
+    w2d_ref,  # [1, F // 32, D] f32
+    o_ref,  # [1, D]
+    acc_ref,  # VMEM [1, D] f32
+    *,
+    n_k: int,
+):
+    ti, ki = pl.program_id(0), pl.program_id(1)
+    w1 = _dequant_block(w1q_ref[0], w1d_ref[0])
+    w3 = _dequant_block(w3q_ref[0], w3d_ref[0])
+    w2 = _dequant_block(w2q_ref[0], w2d_ref[0])
+    _swiglu_accum(
+        x_ref[:], w1, w3, w2, w_ref[ti, ki], ki, n_k, acc_ref, o_ref
+    )
+
+
+def _row_map(ti, ki, idx_ref, w_ref):
+    return (ti, 0)
+
+
+def _sel_map(ti, ki, idx_ref, w_ref):
+    return (idx_ref[ti, ki], 0, 0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -74,40 +137,76 @@ def moe_active_experts(
     w1: jnp.ndarray,  # [E, D, F]
     w2: jnp.ndarray,  # [E, F, D]
     w3: jnp.ndarray,  # [E, D, F]
-    top_i: jnp.ndarray,  # [k] int32 selected expert ids (shared by the m tokens)
-    weights: jnp.ndarray,  # [k] f32 normalized routing weights
+    top_i: jnp.ndarray,  # [m, k] int32 per-token selected expert ids
+    weights: jnp.ndarray,  # [m, k] f32 normalized routing weights
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """SwiGLU-MoE over exactly the selected experts; returns [m, D] f32.
-
-    Note the single shared top-k set: decode with m == 1 is the target. For
-    m > 1 each token generally routes differently — that stays on the dense
-    path.
-    """
+    """SwiGLU-MoE over exactly each token's selected experts; [m, D] f32."""
     m, d = x.shape
     e, _, f = w1.shape
-    k = top_i.shape[0]
-
-    def x_map(i, idx_ref, w_ref):
-        return (0, 0)
-
-    def w_sel_map(i, idx_ref, w_ref):
-        return (idx_ref[i], 0, 0)
+    k = top_i.shape[-1]
+    assert top_i.shape == (m, k), (top_i.shape, m, k)
 
     return pl.pallas_call(
         functools.partial(_moe_kernel, n_k=k),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(k,),
+            grid=(m, k),
             in_specs=[
-                pl.BlockSpec((m, d), x_map),
-                pl.BlockSpec((1, d, f), w_sel_map),
-                pl.BlockSpec((1, d, f), w_sel_map),
-                pl.BlockSpec((1, f, d), w_sel_map),
+                pl.BlockSpec((1, d), _row_map),
+                pl.BlockSpec((1, d, f), _sel_map),
+                pl.BlockSpec((1, d, f), _sel_map),
+                pl.BlockSpec((1, f, d), _sel_map),
             ],
-            out_specs=pl.BlockSpec((m, d), x_map),
-            scratch_shapes=[pltpu.VMEM((m, d), jnp.float32)],
+            out_specs=pl.BlockSpec((1, d), _row_map),
+            scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((m, d), jnp.float32),
         interpret=interpret,
     )(top_i, weights.astype(jnp.float32), x, w1, w3, w2)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def moe_active_experts_q40(
+    x: jnp.ndarray,  # [m, D]
+    w1q: jnp.ndarray,  # [E, D, F] int8
+    w1d: jnp.ndarray,  # [E, D // 32, F] f32
+    w2q: jnp.ndarray,  # [E, F, D] int8
+    w2d: jnp.ndarray,  # [E, F // 32, D] f32
+    w3q: jnp.ndarray,  # [E, D, F] int8
+    w3d: jnp.ndarray,  # [E, D // 32, F] f32
+    top_i: jnp.ndarray,  # [m, k] int32
+    weights: jnp.ndarray,  # [m, k] f32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Quantized ragged MoE: selected experts' Q40 blocks are DMA'd and
+    dequantized in VMEM (0.56x the bytes of bf16 per weight — the same
+    HBM-traffic win as the dense-layer Pallas matmul); [m, D] f32."""
+    m, d = x.shape
+    e, _, f = w1q.shape
+    k = top_i.shape[-1]
+    assert top_i.shape == (m, k), (top_i.shape, m, k)
+
+    return pl.pallas_call(
+        functools.partial(_moe_kernel_q40, n_k=k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(m, k),
+            in_specs=[
+                pl.BlockSpec((1, d), _row_map),
+                pl.BlockSpec((1, d, f), _sel_map),
+                pl.BlockSpec((1, d // Q_BLOCK, f), _sel_map),
+                pl.BlockSpec((1, d, f), _sel_map),
+                pl.BlockSpec((1, d // Q_BLOCK, f), _sel_map),
+                pl.BlockSpec((1, f, d), _sel_map),
+                pl.BlockSpec((1, f // Q_BLOCK, d), _sel_map),
+            ],
+            out_specs=pl.BlockSpec((1, d), _row_map),
+            scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, d), jnp.float32),
+        interpret=interpret,
+    )(
+        top_i, weights.astype(jnp.float32),
+        x.astype(jnp.bfloat16), w1q, w1d, w3q, w3d, w2q, w2d,
+    )

@@ -81,18 +81,45 @@ def main() -> None:
     w2 = jnp.asarray(rng.standard_normal((E, F, D)).astype(np.float32) * 0.05).astype(jnp.bfloat16)
     w3 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.05).astype(jnp.bfloat16)
     xm = jnp.asarray(rng.standard_normal((1, D)).astype(np.float32)).astype(jnp.bfloat16)
-    idx = jnp.asarray(rng.choice(E, K, replace=False).astype(np.int32))
-    wts = jnp.asarray(np.full(K, 1.0 / K, np.float32))
+    idx = jnp.asarray(rng.choice(E, K, replace=False).astype(np.int32))[None, :]
+    wts = jnp.asarray(np.full((1, K), 1.0 / K, np.float32))
     out = moe_active_experts(xm, w1, w2, w3, idx, wts)
     # numpy oracle
     xf = np.asarray(xm, np.float32)
     exp = np.zeros((1, D), np.float32)
-    for i, e in enumerate(np.asarray(idx)):
+    for i, e in enumerate(np.asarray(idx)[0]):
         h1 = xf @ np.asarray(w1[e], np.float32)
         h3 = xf @ np.asarray(w3[e], np.float32)
-        exp += float(wts[i]) * ((h1 / (1 + np.exp(-h1)) * h3) @ np.asarray(w2[e], np.float32))
+        exp += float(wts[0, i]) * ((h1 / (1 + np.exp(-h1)) * h3) @ np.asarray(w2[e], np.float32))
     rel = float(np.abs(np.asarray(out) - exp).max() / (np.abs(exp).max() + 1e-9))
     record("ragged moe rel err", f"{rel:.2e} {'OK' if rel < 5e-2 else 'FAIL'}")
+
+    # 3b. quantized ragged MoE kernel on silicon
+    from dllama_tpu.ops.moe_kernel import moe_active_experts_q40
+    from dllama_tpu.ops.quant_matmul import QuantWeight, dequant as qw_dequant
+
+    def quantize_experts(out_dim, in_dim):
+        qs, ds = [], []
+        for _ in range(E):
+            we = rng.standard_normal((out_dim, in_dim)).astype(np.float32) * 0.05
+            qv_, dv_ = q40_to_planar(quantize_q40(we), out_dim * in_dim)
+            qw_ = from_planar(qv_.reshape(out_dim, in_dim),
+                              dv_.reshape(out_dim, in_dim // 32))
+            qs.append(np.asarray(qw_.q))
+            ds.append(np.asarray(qw_.d))
+        return QuantWeight(jnp.asarray(np.stack(qs)), jnp.asarray(np.stack(ds)))
+
+    qw1, qw3 = quantize_experts(F, D), quantize_experts(F, D)
+    qw2 = quantize_experts(D, F)
+    outq = moe_active_experts_q40(
+        xm, qw1.q, qw1.d, qw2.q, qw2.d, qw3.q, qw3.d, idx, wts
+    )
+    refq = moe_active_experts(
+        xm, qw_dequant(qw1), qw_dequant(qw2), qw_dequant(qw3), idx, wts
+    )
+    rel = float(np.abs(np.asarray(outq) - np.asarray(refq)).max()
+                / (np.abs(np.asarray(refq)).max() + 1e-9))
+    record("ragged moe q40 rel err", f"{rel:.2e} {'OK' if rel < 5e-2 else 'FAIL'}")
 
     def timeit(f, n_iter=50):
         o = f()
@@ -104,11 +131,17 @@ def main() -> None:
         return (time.perf_counter() - t0) / n_iter * 1000
 
     t_ragged = timeit(lambda: moe_active_experts(xm, w1, w2, w3, idx, wts))
+    t_ragged_q = timeit(
+        lambda: moe_active_experts_q40(
+            xm, qw1.q, qw1.d, qw2.q, qw2.d, qw3.q, qw3.d, idx, wts
+        )
+    )
     f_dense = jax.jit(
         lambda xx: jnp.einsum("nd,edf->nef", xx, w1)
     )
     t_dense_w1 = timeit(lambda: f_dense(xm))
     record("moe ragged (full swiglu k experts)", f"{t_ragged:.2f} ms")
+    record("moe ragged q40 (full swiglu k experts)", f"{t_ragged_q:.2f} ms")
     record("moe dense (w1 only, all E)", f"{t_dense_w1:.2f} ms")
 
     # 4. q40 vs dense greedy token parity through the engine (real silicon)

@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 from dllama_tpu.formats import FloatType
+from dllama_tpu.formats.model_file import LlmArch
 from dllama_tpu.runtime.engine import InferenceEngine
 from dllama_tpu.tokenizer import Tokenizer
 
@@ -181,6 +182,27 @@ def test_quant_weight_format_tp(tmp_path):
                          weight_format="q40")
     out4, _, _ = e4.generate([5, 6, 7], max_steps=10)
     assert out1 == out4
+
+
+def test_quant_weight_format_moe_matches_dense(tmp_path):
+    """Qwen3-MoE with weight_format='q40' keeps the expert weights
+    block-quantized on device (the reference stores experts Q40 too,
+    src/llm.cpp:425-499) and must reproduce the dense-load greedy tokens."""
+    from dllama_tpu.ops.quant_matmul import QuantWeight
+
+    mp = str(tmp_path / "moe.m")
+    make_tiny_model(mp, arch=LlmArch.QWEN3_MOE, weight_type=FloatType.Q40)
+    e_dense = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                              weight_format="dense")
+    out_dense, _, _ = e_dense.generate([1, 2, 3, 4], max_steps=12)
+    e_quant = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                              weight_format="q40")
+    # the experts really are stored quantized: int8 values + f32 scales
+    w1 = e_quant.params["layers"]["w1"]
+    assert isinstance(w1, QuantWeight) and w1.q.dtype == jnp.int8
+    assert w1.q.ndim == 4  # [L, E, D, F]
+    out_quant, _, _ = e_quant.generate([1, 2, 3, 4], max_steps=12)
+    assert out_dense == out_quant
 
 
 def test_quant_rejects_non_q40(tmp_path):

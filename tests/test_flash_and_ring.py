@@ -105,6 +105,47 @@ def test_moe_pallas_tp_branch_matches_dense():
     )
 
 
+def test_moe_pallas_tp_quantized_and_multitoken():
+    """The quantized 9-operand shard_map branch and the dp-sharded
+    multi-token branch of _moe_ffn_pallas: tp=2 x dp=2 CPU mesh, interpret
+    mode, 4 tokens with per-token routing, Q40 expert weights — vs the
+    dense MoE over dequantized experts."""
+    from dllama_tpu.formats.quants import q40_to_planar, quantize_q40
+    from dllama_tpu.models.transformer import _moe_ffn, _moe_ffn_pallas
+    from dllama_tpu.ops.jnp_ops import silu
+    from dllama_tpu.ops.quant_matmul import QuantWeight, dequant, from_planar
+
+    rng = np.random.default_rng(22)
+    E, D, F, K = 8, 64, 128, 3
+
+    def make_experts(out_dim, in_dim, seed):
+        qs, ds = [], []
+        for e in range(E):
+            w = rng.standard_normal((out_dim, in_dim)).astype(np.float32) * 0.1
+            qv, dv = q40_to_planar(quantize_q40(w), out_dim * in_dim)
+            qw = from_planar(qv.reshape(out_dim, in_dim),
+                             dv.reshape(out_dim, in_dim // 32))
+            qs.append(np.asarray(qw.q))
+            ds.append(np.asarray(qw.d))
+        return QuantWeight(jnp.asarray(np.stack(qs)), jnp.asarray(np.stack(ds)))
+
+    w1, w3 = make_experts(F, D, 1), make_experts(F, D, 2)
+    w2 = make_experts(D, F, 3)
+    gate = jnp.asarray(rng.standard_normal((D, E)).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((4, 1, D)).astype(np.float32))  # 4 dp lanes
+
+    mesh = make_mesh(tp=2, dp=2)
+    out = _moe_ffn_pallas(x, gate, w1, w2, w3, K, mesh, interpret=True)
+    dense = _moe_ffn(
+        x, gate, dequant(w1, jnp.float32), dequant(w2, jnp.float32),
+        dequant(w3, jnp.float32), K, silu,
+    )
+    # bf16 tolerance: the kernel computes in bf16, the reference in f32
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense), rtol=2e-2, atol=2e-2
+    )
+
+
 def test_flash_stats_matches_jnp_stats():
     """Pallas flash-stats kernel vs the shared jnp partial-state math,
     across query/key offsets (normalized output + log-sum-exp invariants)."""

@@ -67,8 +67,10 @@ def test_quantweight_is_pytree():
     assert len(leaves) == 2
 
 
-def test_moe_active_experts_kernel():
-    """Ragged MoE kernel vs the dense jnp path (interpret mode)."""
+@pytest.mark.parametrize("m", [1, 4])
+def test_moe_active_experts_kernel(m):
+    """Ragged MoE kernel (per-token top-k) vs the dense jnp path
+    (interpret mode)."""
     import jax
     from jax import lax
 
@@ -80,17 +82,68 @@ def test_moe_active_experts_kernel():
     w2 = jnp.asarray(rng.standard_normal((E, F, D)).astype(np.float32) * 0.1)
     w3 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.1)
     gate = jnp.asarray(rng.standard_normal((D, E)).astype(np.float32))
-    x = jnp.asarray(rng.standard_normal((1, D)).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((m, D)).astype(np.float32))
 
     probs = jax.nn.softmax(x @ gate, axis=-1)
-    top_p, top_i = lax.top_k(probs[0], K)
-    weights = top_p / top_p.sum()
+    top_p, top_i = lax.top_k(probs, K)  # [m, K]
+    weights = top_p / top_p.sum(axis=-1, keepdims=True)
     out = moe_active_experts(x, w1, w2, w3, top_i, weights, interpret=True)
 
     from dllama_tpu.models.transformer import _moe_ffn
     from dllama_tpu.ops.jnp_ops import silu
 
-    dense = _moe_ffn(x[None], gate, w1, w2, w3, K, silu)
+    dense = _moe_ffn(x[:, None], gate, w1, w2, w3, K, silu)  # [m, 1, D]
     np.testing.assert_allclose(
-        np.asarray(out), np.asarray(dense)[0], rtol=1e-5, atol=1e-5
+        np.asarray(out), np.asarray(dense)[:, 0], rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("m", [1, 4])
+def test_moe_active_experts_q40_kernel(m):
+    """Quantized ragged MoE kernel vs dequant-then-dense-kernel: the only
+    difference source is where the dequant happens (in-VMEM vs host), so
+    tolerances are bf16-rounding tight."""
+    import jax
+    from jax import lax
+
+    from dllama_tpu.ops.moe_kernel import (
+        moe_active_experts,
+        moe_active_experts_q40,
+    )
+
+    rng = np.random.default_rng(7)
+    E, D, F, K = 8, 64, 96, 3
+
+    def make_experts(out_dim, in_dim, seed):
+        qs, ds = [], []
+        for e in range(E):
+            qw, _ = make_qw(out_dim, in_dim, seed=seed * 100 + e)
+            qs.append(np.asarray(qw.q))
+            ds.append(np.asarray(qw.d))
+        return QuantWeight(jnp.asarray(np.stack(qs)), jnp.asarray(np.stack(ds)))
+
+    w1 = make_experts(F, D, 1)  # device layout: q [E, D, F]
+    w3 = make_experts(F, D, 2)
+    w2 = make_experts(D, F, 3)  # q [E, F, D]
+    gate = jnp.asarray(rng.standard_normal((D, E)).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((m, D)).astype(np.float32))
+
+    probs = jax.nn.softmax(x @ gate, axis=-1)
+    top_p, top_i = lax.top_k(probs, K)
+    weights = top_p / top_p.sum(axis=-1, keepdims=True)
+
+    out = moe_active_experts_q40(
+        x, w1.q, w1.d, w2.q, w2.d, w3.q, w3.d, top_i, weights, interpret=True
+    )
+    expected = moe_active_experts(
+        x.astype(jnp.bfloat16),
+        dequant(w1, jnp.bfloat16),
+        dequant(w2, jnp.bfloat16),
+        dequant(w3, jnp.bfloat16),
+        top_i,
+        weights,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=2e-2, atol=2e-2
     )
