@@ -37,6 +37,10 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--net-turbo", type=int, default=1, help="accepted for CLI parity")
     p.add_argument("--nbatches", "--n-batches", type=int, default=32, dest="nbatches", help="prefill chunk size")
     p.add_argument("--tp", type=int, default=0, help="tensor-parallel chips (default: all)")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel chips: shard the KV cache's "
+                        "sequence axis for long contexts (ring prefill + "
+                        "merged-stats decode); total chips = tp x sp")
     p.add_argument("--workers", nargs="*", default=None, help="alias for --tp: pass a chip count (host:port lists are a LAN-cluster concept)")
     p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--kv-dtype", default=None, choices=[None, "bf16", "f32"])
@@ -98,14 +102,16 @@ def load_engine(args):
     )
     tok = Tokenizer(args.tokenizer)
     tp = _resolve_tp(args)
+    sp = getattr(args, "sp", 1) or 1
     if tp == 0:
         from .parallel.mesh import auto_tp
 
-        tp = auto_tp(args.model)
+        tp = auto_tp(args.model, n_devices=len(jax.devices()) // sp)
     engine = InferenceEngine(
         args.model,
         tokenizer=tok,
         tp=tp,
+        sp=sp,
         dtype=dtype,
         kv_dtype=kv_dtype,
         max_seq_len=args.max_seq_len,
@@ -129,6 +135,8 @@ def load_engine(args):
         print(f"💡 nActiveExperts: {h.n_active_experts}")
     print(f"💡 SeqLen: {h.seq_len}")
     print(f"💡 Tp: {tp} chip(s) [{jax.default_backend()}]")
+    if sp > 1:
+        print(f"💡 Sp: {sp} sequence shards")
     if tok.vocab_size != h.vocab_size:
         print(
             f"⚠️  tokenizer vocab ({tok.vocab_size}) != model vocab "

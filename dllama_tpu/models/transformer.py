@@ -32,7 +32,12 @@ from jax import lax
 from ..formats.model_file import HiddenAct, LlmArch, LlmHeader, RopeType
 from ..ops.jnp_ops import apply_rope, gelu, qk_rms_norm, rms_norm, silu
 from ..ops.quant_matmul import QuantWeight, dequant, qmatmul_tp
-from ..ops.flash_attention import flash_attention, pick_flash_blocks
+from ..ops.flash_attention import (
+    flash_attention,
+    flash_decode,
+    pick_decode_block,
+    pick_flash_blocks,
+)
 from ..ops.moe_kernel import moe_active_experts, moe_active_experts_q40
 
 Params = Dict[str, Any]
@@ -71,26 +76,30 @@ def _attention_tp(
     head_dim: int,
     mesh,
 ) -> jnp.ndarray:
-    """Attention dispatch: the Pallas flash kernel on TPU for prefill-sized
-    T (blockwise online softmax, no [T, S] score materialization — the
-    long-context replacement for multiheadAtt_F32), einsum elsewhere and
-    for single-token decode where one [S] row is cheap.
+    """Attention dispatch on TPU: the flash-decode kernel for T=1 (per-step
+    cache reads bounded by pos via DMA-elided block clamping — the O(pos)
+    property of the reference's decode attention), the prefill flash
+    kernel for T >= 8 (blockwise online softmax, no [T, S] score
+    materialization — the long-context replacement for multiheadAtt_F32),
+    einsum elsewhere.
 
-    Heads are the TP axis (reference: sliceMultiHeadAtt), so the kernel
-    runs per-shard under shard_map with no collectives.
+    Heads are the TP axis (reference: sliceMultiHeadAtt), so the kernels
+    run per-shard under shard_map with no collectives.
     """
     b, t = q.shape[0], q.shape[1]
-    use_flash = (
-        jax.default_backend() == "tpu"
-        and t >= 8
-        and pick_flash_blocks(t, k_cache.shape[1]) is not None
-    )
-    if not use_flash:
-        out = _attention(q, k_cache, v_cache, pos, head_dim)
-        return out
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        return _attention_sp(q, k_cache, v_cache, pos, head_dim, mesh)
+    on_tpu = jax.default_backend() == "tpu"
+    s = k_cache.shape[1]
+    if on_tpu and t == 1 and pick_decode_block(s) is not None:
+        kernel = flash_decode
+    elif on_tpu and t >= 8 and pick_flash_blocks(t, s) is not None:
+        kernel = flash_attention
+    else:
+        return _attention(q, k_cache, v_cache, pos, head_dim)
     n_heads = q.shape[2]
     if mesh is None or mesh.devices.size == 1:
-        out = flash_attention(q, k_cache, v_cache, pos)
+        out = kernel(q, k_cache, v_cache, pos)
     else:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
@@ -98,12 +107,88 @@ def _attention_tp(
         spec_q = P("dp", None, "tp", None)
         spec_kv = P("dp", None, "tp", None)
         out = shard_map(
-            lambda qq, kk, vv, pp: flash_attention(qq, kk, vv, pp),
+            lambda qq, kk, vv, pp: kernel(qq, kk, vv, pp),
             mesh=mesh,
             in_specs=(spec_q, spec_kv, spec_kv, P()),
             out_specs=spec_q,
             check_vma=False,
         )(q, k_cache, v_cache, pos)
+    return out.reshape(b, t, n_heads * head_dim)
+
+
+def _attention_sp(
+    q: jnp.ndarray,  # [B, T, H, hd]
+    k_cache: jnp.ndarray,  # [B, S, KH, hd] — S sharded over "sp"
+    v_cache: jnp.ndarray,
+    pos: jnp.ndarray,
+    head_dim: int,
+    mesh,
+) -> jnp.ndarray:
+    """Sequence-parallel attention: the KV cache's sequence axis lives on
+    the `sp` mesh axis (the long-context scaling axis the reference lacks —
+    SURVEY.md §5 marks SP/ring absent there).
+
+    Decode (T=1): every sp shard computes online-softmax partial state over
+    its local KV rows, merged with a log-sum-exp pmax/psum — the collective
+    payload is [B, KH, G, 1(, hd)], tiny next to the cache reads it saves.
+
+    Prefill (T % sp == 0): queries shard over sp too and the KV shards
+    rotate around the ring (parallel/ring_attention.ring_attention_local),
+    overlapping each hop's ppermute with the local block compute.
+
+    Heads stay tp-sharded inside the same shard_map — attention needs no
+    tp collectives (reference: sliceMultiHeadAtt head independence)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.jnp_ops import attention_stats
+    from ..parallel.ring_attention import ring_attention_local
+
+    b, t, n_heads = q.shape[0], q.shape[1], q.shape[2]
+    s = k_cache.shape[1]
+    sp = mesh.shape["sp"]
+    shard = s // sp
+    kv_spec = P("dp", "sp", "tp", None)
+
+    if t == 1:
+        q_spec = P("dp", None, "tp", None)
+
+        def body(qq, kk, vv, pp):
+            idx = lax.axis_index("sp")
+            acc, m, l = attention_stats(qq, kk, vv, pp, idx * shard)
+            m_g = lax.pmax(m, "sp")
+            scale = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - m_g))
+            l_g = lax.psum(l * scale, "sp")
+            acc_g = lax.psum(acc * scale[..., None], "sp")
+            l_safe = jnp.where(l_g == 0.0, 1.0, l_g)
+            out = acc_g / l_safe[..., None]  # [b, kh, g, 1, hd]
+            bb, kh, g, tq, hd = out.shape
+            return (
+                out.transpose(0, 3, 1, 2, 4)
+                .reshape(bb, tq, kh * g, hd)
+                .astype(qq.dtype)
+            )
+
+    else:
+        q_spec = P("dp", "sp", "tp", None)
+
+        def body(qq, kk, vv, pp):
+            idx = lax.axis_index("sp")
+            tq = qq.shape[1]
+            return ring_attention_local(
+                qq, kk, vv,
+                q_pos0=pp + idx * tq,
+                shard_size=jnp.int32(shard),
+                axis_name="sp",
+            )
+
+    out = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, P()),
+        out_specs=q_spec,
+        check_vma=False,
+    )(q, k_cache, v_cache, pos)
     return out.reshape(b, t, n_heads * head_dim)
 
 

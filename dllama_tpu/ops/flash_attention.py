@@ -14,7 +14,10 @@ Semantics match models/transformer._attention exactly:
 
 Kernel layout: grid (B * H, T blocks, S blocks), S innermost so the online
 softmax state (m, l, acc) lives in VMEM scratch across S steps. S blocks
-entirely above the causal diagonal are compute-skipped via pl.when.
+entirely above the causal frontier are compute-skipped via pl.when AND
+DMA-skipped via a clamped kv index map (a repeated block index elides the
+HBM->VMEM copy), with the cache consumed in its native [B, S, KH, hd]
+layout so no transposed copy of it is ever materialized.
 """
 
 from __future__ import annotations
@@ -57,8 +60,8 @@ def attention_ref(
 def _flash_stats_kernel(
     pos_ref,  # SMEM scalar prefetch: [2] int32 (q_pos0, s_pos0)
     q_ref,  # [1, bt, hd]
-    k_ref,  # [1, bs, hd]
-    v_ref,  # [1, bs, hd]
+    k_ref,  # [1, bs, 1, hd] — native-layout cache tile (no pre-transpose)
+    v_ref,  # [1, bs, 1, hd]
     acc_out,  # [1, bt, hd]
     m_out,  # [1, bt, 128]
     l_out,  # [1, bt, 128]
@@ -90,7 +93,7 @@ def _flash_stats_kernel(
     @pl.when(s_start <= q_pos0 + block_t - 1)
     def _compute():
         q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)
         scores = (
             jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -109,7 +112,7 @@ def _flash_stats_kernel(
         p = jnp.where(m_new <= _NEG_INF / 2, 0.0, p)
         alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        v = v_ref[0].astype(jnp.float32)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -162,9 +165,10 @@ def flash_attention_stats(
     n_s = s // block_s
     scale = 1.0 / (hd**0.5)
 
+    # queries transpose is chunk-sized (cheap); the cache stays in its
+    # native [B, S, KH, hd] layout — a pre-transpose would copy all S rows
+    # per call
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * kh, s, hd)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * kh, s, hd)
     pos_arr = jnp.stack(
         [jnp.asarray(q_pos0, jnp.int32), jnp.asarray(s_pos0, jnp.int32)]
     )
@@ -173,9 +177,13 @@ def flash_attention_stats(
         return (bh, ti, 0)
 
     def kv_map(bh, ti, si, pos_ref):
-        bi = bh // h
-        hi = bh % h
-        return (bi * kh + hi // g, si, 0)
+        # clamp past the causal frontier of this query tile: revisiting a
+        # block index elides the DMA, so fully-masked tiles (and cache rows
+        # beyond pos in chunked prefill) cost no HBM traffic
+        limit = jnp.maximum(
+            (pos_ref[0] + (ti + 1) * block_t - 1 - pos_ref[1]) // block_s, 0
+        )
+        return (bh // h, jnp.minimum(si, limit), (bh % h) // g, 0)
 
     acc, m, l = pl.pallas_call(
         functools.partial(
@@ -190,8 +198,8 @@ def flash_attention_stats(
             grid=(b * h, n_t, n_s),
             in_specs=[
                 pl.BlockSpec((1, block_t, hd), q_map),
-                pl.BlockSpec((1, block_s, hd), kv_map),
-                pl.BlockSpec((1, block_s, hd), kv_map),
+                pl.BlockSpec((1, block_s, 1, hd), kv_map),
+                pl.BlockSpec((1, block_s, 1, hd), kv_map),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_t, hd), q_map),
@@ -210,13 +218,174 @@ def flash_attention_stats(
             jax.ShapeDtypeStruct((b * h, t, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(pos_arr, qt, kt, vt)
+    )(pos_arr, qt, k, v)
 
     # [B*H, T, ...] -> [B, KH, G, T, ...]
     acc = acc.reshape(b, kh, g, t, hd)
     m = m[:, :, 0].reshape(b, kh, g, t)
     l = l[:, :, 0].reshape(b, kh, g, t)
     return acc, m, l
+
+
+def _flash_decode_kernel(
+    pos_ref,  # SMEM scalar prefetch: [1] int32 (absolute query position)
+    q_ref,  # [1, G, hd] (the G query heads sharing this KV head)
+    k_ref,  # [1, bs, 1, hd] — a native-layout cache tile (no pre-transpose)
+    v_ref,  # [1, bs, 1, hd]
+    o_ref,  # [1, G, hd]
+    m_ref,  # VMEM [G, 128]
+    l_ref,  # VMEM [G, 128]
+    acc_ref,  # VMEM [G, hd]
+    *,
+    block_s: int,
+    n_s: int,
+    scale: float,
+):
+    """T=1 decode step: one query token per lane group, online softmax over
+    S blocks. Blocks entirely beyond `pos` are compute-skipped here AND
+    DMA-skipped by the clamped kv index map (`pl.pallas_call` elides the
+    HBM->VMEM copy when the block index repeats), so per-step cache reads
+    are proportional to pos — the O(pos) property of the reference's
+    decode attention (src/nn/nn-cpu-ops.cpp:753-788) — while the compiled
+    program covers the whole cache (no per-window recompiles)."""
+    si = pl.program_id(1)
+    pos = pos_ref[0]
+
+    @pl.when(si == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    s_start = si * block_s
+
+    @pl.when(s_start <= pos)
+    def _compute():
+        g = q_ref.shape[1]
+        q = q_ref[0].astype(jnp.float32)  # [G, hd]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bs, hd]
+        scores = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [G, bs]
+        s_pos = s_start + jax.lax.broadcasted_iota(
+            jnp.int32, (g, block_s), 1
+        )
+        scores = jnp.where(s_pos <= pos, scores, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(scores, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        p = jnp.where(m_new <= _NEG_INF / 2, 0.0, p)
+        alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(si == n_s - 1)
+    def _emit():
+        # pos indexes a row written this step (the engine appends k/v at
+        # pos before attention), so l >= 1 always; the guard is belt and
+        # braces for direct op-level callers
+        l_safe = jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def pick_decode_block(s: int) -> int | None:
+    """KV block length for the decode kernel: largest multiple of 128
+    <= 1024 dividing s, or None (caller falls back to dense)."""
+    return next(
+        (b for b in range(min(1024, s - s % 128), 0, -128) if s % b == 0),
+        None,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("block_s", "interpret"))
+def flash_decode(
+    q: jnp.ndarray,  # [B, 1, H, hd]
+    k_cache: jnp.ndarray,  # [B, S, KH, hd]
+    v_cache: jnp.ndarray,  # [B, S, KH, hd]
+    pos: jnp.ndarray,  # scalar int32
+    block_s: int = 0,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Single-token causal GQA attention; returns [B, 1, H, hd] in q.dtype.
+
+    The G = H/KH query heads of each KV group ride the sublane dim (one
+    [G, hd] x [hd, block_s] matmul per KV block), and the kv BlockSpec
+    index map clamps at pos's block so the pipeline only moves ~pos rows
+    of cache per step regardless of allocated seq_len. The cache is
+    consumed in its NATIVE [B, S, KH, hd] layout via 4-D BlockSpecs — a
+    pre-transpose would materialize a full copy of the cache per step and
+    defeat the whole point.
+    """
+    b, t, h, hd = q.shape
+    assert t == 1, "flash_decode is the T=1 path"
+    s, kh = k_cache.shape[1], k_cache.shape[2]
+    g = h // kh
+    if not block_s:
+        picked = pick_decode_block(s)
+        if picked is None:
+            if not interpret:
+                raise ValueError(
+                    f"no valid decode block for s={s}; use dense attention"
+                )
+            picked = s
+        block_s = picked
+    assert s % block_s == 0, (s, block_s)
+    n_s = s // block_s
+    scale = 1.0 / (hd**0.5)
+
+    # [B, 1, H, hd] -> [B * KH, G, hd] (pure reshape: T=1, no data movement)
+    qt = q.reshape(b, kh, g, hd).reshape(b * kh, g, hd)
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+
+    def q_map(bk, si, pos_ref):
+        return (bk, 0, 0)
+
+    def kv_map(bk, si, pos_ref):
+        # clamp: revisiting the same block index elides the DMA, so blocks
+        # beyond pos cost no HBM traffic
+        return (
+            bk // kh,
+            jnp.minimum(si, pos_ref[0] // block_s),
+            bk % kh,
+            0,
+        )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_decode_kernel, block_s=block_s, n_s=n_s, scale=scale
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * kh, n_s),
+            in_specs=[
+                pl.BlockSpec((1, g, hd), q_map),
+                pl.BlockSpec((1, block_s, 1, hd), kv_map),
+                pl.BlockSpec((1, block_s, 1, hd), kv_map),
+            ],
+            out_specs=pl.BlockSpec((1, g, hd), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((g, 128), jnp.float32),
+                pltpu.VMEM((g, 128), jnp.float32),
+                pltpu.VMEM((g, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * kh, g, hd), jnp.float32),
+        interpret=interpret,
+    )(pos_arr, qt, k_cache, v_cache)
+
+    return out.reshape(b, kh, g, hd).reshape(b, 1, h, hd).astype(q.dtype)
 
 
 def flash_attention(

@@ -63,10 +63,15 @@ def param_spec_tree(h: LlmHeader) -> dict[str, Any]:
     }
 
 
-def cache_specs(h: LlmHeader) -> dict[str, P]:
+def cache_specs(h: LlmHeader, sp: bool = False) -> dict[str, P]:
     """KV cache [L, B, S, KH, hd]: batch over dp, kv-heads over tp
-    (reference: sliceKvCache, src/nn/nn-core.cpp:211-218)."""
-    spec = P(None, "dp", None, "tp", None)
+    (reference: sliceKvCache, src/nn/nn-core.cpp:211-218). With `sp` the
+    sequence axis additionally shards over the sp mesh axis — the
+    long-context layout ring/merged attention consumes
+    (models/transformer._attention_sp)."""
+    spec = (
+        P(None, "dp", "sp", "tp", None) if sp else P(None, "dp", None, "tp", None)
+    )
     return {"k": spec, "v": spec}
 
 

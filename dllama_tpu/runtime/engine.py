@@ -80,6 +80,7 @@ class InferenceEngine:
         tokenizer: Tokenizer | None = None,
         tp: int = 1,
         dp: int = 1,
+        sp: int = 1,
         dtype=jnp.bfloat16,
         kv_dtype=None,
         max_seq_len: int = 0,
@@ -95,17 +96,31 @@ class InferenceEngine:
         self.header: LlmHeader = self.reader.header
         self.tokenizer = tokenizer
         validate_tp(self.header, tp)
-        self.mesh = make_mesh(tp=tp, dp=dp)
-        self.tp, self.dp = tp, dp
+        # sequence parallelism: the KV cache's sequence axis shards over sp
+        # chips (the long-context axis; models/transformer._attention_sp).
+        # Shard boundaries must tile the cache.
+        if sp < 1 or (sp & (sp - 1)) != 0:
+            raise ValueError(f"sp must be a power of two >= 1, got {sp}")
+        if sp > 1 and self.header.seq_len % sp != 0:
+            raise ValueError(
+                f"seqLen {self.header.seq_len} not divisible by sp={sp}"
+            )
+        self.mesh = make_mesh(tp=tp, dp=dp, sp=sp)
+        self.tp, self.dp, self.sp = tp, dp, sp
         self.batch_size = batch_size
         self.dtype = dtype
         self.kv_dtype = kv_dtype or dtype
         self.sampler = Sampler(self.header.vocab_size, temperature, topp, seed)
         self.temperature = temperature
         self._precision = matmul_precision
+        # sp > 1: prefill chunks > 1 token shard their query axis over sp,
+        # so buckets must divide evenly (width-1 chunks go through the
+        # merged-stats decode branch instead)
         self.prefill_buckets = tuple(
-            b for b in sorted(prefill_buckets) if b <= self.header.seq_len
-        ) or (1,)
+            b
+            for b in sorted(prefill_buckets)
+            if b <= self.header.seq_len and (sp == 1 or b == 1 or b % sp == 0)
+        ) or ((1,) if sp == 1 else (sp,))
 
         # "auto": keep Q40 weights quantized on device when the Pallas path
         # is available (TPU); dense bf16/f32 elsewhere (the CPU fallback
@@ -141,7 +156,7 @@ class InferenceEngine:
         )
         self._cache_sharding = {
             k: NamedSharding(self.mesh, spec)
-            for k, spec in cache_specs(self.header).items()
+            for k, spec in cache_specs(self.header, sp=sp > 1).items()
         }
         self.cache = self._fresh_cache()
         self._token_sharding = NamedSharding(self.mesh, P("dp", None))
@@ -176,14 +191,41 @@ class InferenceEngine:
         program per window keeps decode reads proportional to the context
         actually used instead of the allocated seq_len."""
         s = self.header.seq_len
+        if self.sp > 1:
+            # windowing would slice the sp-sharded sequence axis out of
+            # alignment, so sp runs read the full per-shard cache each step
+            # (1/sp of the global cache; a shard-local pos-clamped decode
+            # kernel to bound this further is in ROADMAP.md)
+            return s
         w = 512
         while w < limit:
             w *= 2
         # NB: crossing a window boundary mid-generation compiles a fresh
         # program for the next window (one synchronous stall per crossing,
-        # log2(seq_len/512) of them worst case); pre-warming the next
-        # window asynchronously is a known follow-up (ROADMAP.md)
+        # log2(seq_len/512) of them worst case). This only applies to
+        # prefill and the CPU decode path: TPU decode uses the flash-decode
+        # kernel whose cache reads are pos-bounded inside ONE full-length
+        # program (`_decode_window`), so no decode recompiles happen.
         return min(w, s)
+
+    def _decode_window(self, limit: int) -> int:
+        """Window for T=1 decode programs. On TPU the flash-decode kernel
+        bounds per-step cache reads by pos via its clamped DMA schedule, so
+        a single full-cache program (window 0) serves every position with
+        no window-crossing recompile stalls; elsewhere fall back to the
+        bucketed windows."""
+        from ..ops.flash_attention import pick_decode_block
+
+        if self.sp > 1:
+            # full sharded cache view: each sp shard scores its 1/sp of
+            # the rows (dense, masked) and merges stats — see _attn_window
+            return 0
+        if (
+            jax.default_backend() == "tpu"
+            and pick_decode_block(self.header.seq_len) is not None
+        ):
+            return 0
+        return self._attn_window(limit)
 
     def _step_fn(self, t: int, greedy: bool, window: int = 0):
         """Build/jit the forward step for chunk length `t`."""
@@ -288,7 +330,7 @@ class InferenceEngine:
             arr = jnp.asarray([[token]] * self.batch_size, dtype=jnp.int32)
         arr = jax.device_put(arr, self._token_sharding)
         greedy = self.temperature == 0.0
-        window = self._attn_window(pos + n_steps)
+        window = self._decode_window(pos + n_steps)
         block = self._decode_block_fn(n_steps, greedy, window)
         # fold in a call counter so successive generations differ (the
         # reference's xorshift state advances across calls the same way)
@@ -391,7 +433,7 @@ class InferenceEngine:
         arr = jnp.asarray([[token]] * self.batch_size, dtype=jnp.int32)
         arr = jax.device_put(arr, self._token_sharding)
         greedy = self.temperature == 0.0
-        step = self._step_fn(1, greedy=greedy, window=self._attn_window(pos + 1))
+        step = self._step_fn(1, greedy=greedy, window=self._decode_window(pos + 1))
         t0 = time.perf_counter()
         out, self.cache = step(self.params, arr, self.cache, jnp.int32(pos))
         out = jax.block_until_ready(out)

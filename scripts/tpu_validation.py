@@ -73,6 +73,39 @@ def main() -> None:
     )
     record("flash attn abs err (bf16)", f"{rel:.2e} {'OK' if rel < 2e-2 else 'FAIL'}")
 
+    def timeit(f, n_iter=50):
+        o = f()
+        sync(o)
+        t0 = time.perf_counter()
+        for _ in range(n_iter):
+            o = f()
+        sync(o)
+        return (time.perf_counter() - t0) / n_iter * 1000
+
+    # 2b. flash decode (T=1) numerics + pos-bounded DMA proof
+    from dllama_tpu.ops.flash_attention import flash_decode
+
+    S = 16384 if quick else 32768
+    qd = jnp.asarray(rng.standard_normal((1, 1, 8, 64)).astype(np.float32)).astype(jnp.bfloat16)
+    kd = jnp.asarray(rng.standard_normal((1, S, 4, 64)).astype(np.float32)).astype(jnp.bfloat16)
+    vd = jnp.asarray(rng.standard_normal((1, S, 4, 64)).astype(np.float32)).astype(jnp.bfloat16)
+    for p in (100, S - 1):
+        fo = flash_decode(qd, kd, vd, jnp.int32(p))
+        fr = attention_ref(qd, kd, vd, jnp.int32(p))
+        err = float(jnp.abs(fo.astype(jnp.float32) - fr.astype(jnp.float32)).max())
+        record(f"flash decode abs err pos={p}", f"{err:.2e} {'OK' if err < 2e-2 else 'FAIL'}")
+
+    t_low = timeit(lambda: flash_decode(qd, kd, vd, jnp.int32(512)))
+    t_high = timeit(lambda: flash_decode(qd, kd, vd, jnp.int32(S - 1)))
+    # clamped DMA schedule => decode at pos=512 must be much cheaper than
+    # at pos=S-1 even though both run the same full-cache program
+    ratio = t_high / max(t_low, 1e-9)
+    record(
+        "flash decode pos-bounded reads",
+        f"pos512 {t_low:.3f} ms vs pos{S-1} {t_high:.3f} ms "
+        f"(x{ratio:.1f}) {'OK' if ratio > 4 else 'FAIL (reads not pos-bounded)'}",
+    )
+
     # 3. ragged MoE kernel on silicon + timing vs dense
     from dllama_tpu.ops.moe_kernel import moe_active_experts
 
@@ -120,15 +153,6 @@ def main() -> None:
     rel = float(np.abs(np.asarray(outq) - np.asarray(refq)).max()
                 / (np.abs(np.asarray(refq)).max() + 1e-9))
     record("ragged moe q40 rel err", f"{rel:.2e} {'OK' if rel < 5e-2 else 'FAIL'}")
-
-    def timeit(f, n_iter=50):
-        o = f()
-        sync(o)
-        t0 = time.perf_counter()
-        for _ in range(n_iter):
-            o = f()
-        sync(o)
-        return (time.perf_counter() - t0) / n_iter * 1000
 
     t_ragged = timeit(lambda: moe_active_experts(xm, w1, w2, w3, idx, wts))
     t_ragged_q = timeit(

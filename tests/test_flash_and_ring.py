@@ -32,6 +32,48 @@ def test_flash_matches_reference(pos):
     )
 
 
+@pytest.mark.parametrize("pos", [0, 1, 7, 8, 30, 31])
+def test_flash_decode_matches_reference(pos):
+    """T=1 decode kernel vs the dense reference across positions, incl.
+    block boundaries (block_s=8) and the last cache row."""
+    from dllama_tpu.ops.flash_attention import flash_decode
+
+    q, k, v = make_qkv(1, 1, 4, 2, 16, 32, seed=11)
+    ref = attention_ref(q, k, v, jnp.int32(pos))
+    out = flash_decode(q, k, v, jnp.int32(pos), block_s=8, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("h,kh", [(8, 8), (8, 2), (4, 1)])
+def test_flash_decode_gqa_groupings(h, kh):
+    """MHA (G=1), GQA (G=4), MQA-ish (G=4 single kv head) and batch > 1."""
+    from dllama_tpu.ops.flash_attention import flash_decode
+
+    q, k, v = make_qkv(2, 1, h, kh, 16, 64, seed=12)
+    for pos in (3, 40, 63):
+        ref = attention_ref(q, k, v, jnp.int32(pos))
+        out = flash_decode(q, k, v, jnp.int32(pos), block_s=16, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"h={h} kh={kh} pos={pos}",
+        )
+
+
+def test_flash_decode_bf16():
+    from dllama_tpu.ops.flash_attention import flash_decode
+
+    q, k, v = make_qkv(1, 1, 4, 2, 32, 64, seed=13)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ref = attention_ref(q, k, v, jnp.int32(50))
+    out = flash_decode(q, k, v, jnp.int32(50), block_s=16, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
 def test_flash_multi_batch_gqa():
     q, k, v = make_qkv(2, 16, 8, 2, 16, 64, seed=3)
     ref = attention_ref(q, k, v, jnp.int32(48))
