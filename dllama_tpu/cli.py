@@ -290,34 +290,20 @@ def run_chat(args) -> None:
 
 
 def run_perplexity(args) -> None:
-    """Teacher-forced NLL over the prompt — the numerical-quality oracle
-    (reference: dllama.cpp:132-172)."""
-    import numpy as np
-
+    """Teacher-forced NLL over the prompt — the numerical-quality oracle.
+    Scored chunk-by-chunk on device through the engine's bucketed prefill
+    programs, shipping one scalar per chunk instead of a [T, vocab] logits
+    tensor (the reference walks the prompt in nBatches chunks and reads
+    the logits pipe per batch, src/dllama.cpp:132-172)."""
     engine, tok = load_engine(args)
     if args.prompt is None:
         raise SystemExit("Prompt is required")
     tokens = tok.encode(args.prompt, is_start=True, add_special_tokens=True)
     if len(tokens) < 2:
         raise SystemExit("Prompt too short for perplexity")
-
-    # Run the full prompt through the model in one (bucketed) pass and score
-    # every next-token prediction.
-    import jax.numpy as jnp
-
-    from .models import forward, init_kv_cache
-
-    cache = engine._fresh_cache()
-    t = len(tokens)
-    arr = jnp.asarray([tokens] * engine.batch_size, dtype=jnp.int32)
-    logits, _ = forward(
-        engine.params, engine.header, arr, jnp.int32(0), cache, mesh=engine.mesh
-    )
-    lg = np.asarray(logits, dtype=np.float32)[0]  # [T, V]
-    logprobs = lg - np.log(np.exp(lg - lg.max(-1, keepdims=True)).sum(-1, keepdims=True)) - lg.max(-1, keepdims=True)
-    nll = -np.mean([logprobs[i, tokens[i + 1]] for i in range(t - 1)])
-    ppl = float(np.exp(nll))
-    print(f"    nTokens: {t}")
+    nll, ppl, n_scored = engine.perplexity(tokens)
+    print(f"    nTokens: {len(tokens)}")
+    print(f"    nScored: {n_scored}")
     print(f"        nll: {nll:.4f}")
     print(f" perplexity: {ppl:.4f}")
 

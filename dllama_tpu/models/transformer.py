@@ -171,6 +171,16 @@ def _attention_sp(
 
     else:
         q_spec = P("dp", "sp", "tp", None)
+        # same auto-select as the ring_attention wrapper: the Pallas
+        # flash-stats local step on TPU when the per-shard shapes tile,
+        # else the dense jnp stats math
+        from ..ops.flash_attention import pick_flash_blocks
+
+        tq_local = t // sp
+        use_flash = (
+            jax.default_backend() == "tpu"
+            and pick_flash_blocks(tq_local, shard) is not None
+        )
 
         def body(qq, kk, vv, pp):
             idx = lax.axis_index("sp")
@@ -180,6 +190,7 @@ def _attention_sp(
                 q_pos0=pp + idx * tq,
                 shard_size=jnp.int32(shard),
                 axis_name="sp",
+                use_flash=use_flash,
             )
 
     out = shard_map(

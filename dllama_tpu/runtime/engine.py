@@ -352,6 +352,102 @@ class InferenceEngine:
             return [[int(t) for t in row] for row in out]
         return [int(t) for t in out[:, 0]]
 
+    def _score_fn(self, t: int, window: int = 0):
+        """Build/jit the teacher-forced scoring step for chunk length `t`:
+        returns the summed next-token NLL of the chunk's unmasked rows as
+        ONE scalar (no [T, vocab] logits transfer — the reference ships the
+        full logits pipe to host per batch, src/dllama.cpp:132-172)."""
+        key = ("score", t, window)
+        if key in self._compiled:
+            return self._compiled[key]
+        h = self.header
+        mesh = self.mesh
+        precision = self._precision
+
+        @partial(jax.jit, donate_argnums=(4,))
+        def score(params, tokens, targets, mask, cache, pos):
+            ctx = (
+                jax.default_matmul_precision(precision)
+                if precision
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                logits, cache = forward(
+                    params, h, tokens, pos, cache, mesh=mesh, attn_window=window
+                )
+            lg = logits.astype(jnp.float32)  # [B, T, V]
+            lse = jax.nn.logsumexp(lg, axis=-1)  # [B, T]
+            tgt = jnp.take_along_axis(lg, targets[..., None], axis=-1)[..., 0]
+            nll = (lse - tgt) * mask
+            return jnp.sum(nll[0]), cache
+
+        self._compiled[key] = score
+        return score
+
+    def perplexity(self, tokens: list[int]) -> tuple[float, float, int]:
+        """Teacher-forced (nll, perplexity, n_scored) over `tokens`,
+        scored chunk-by-chunk through the bucketed prefill programs — the
+        result is chunk-size invariant and compiles only bucket-shaped
+        programs (the reference walks the prompt in nBatches chunks the
+        same way, src/dllama.cpp:132-172)."""
+        t = len(tokens)
+        if t < 2:
+            raise ValueError("need at least 2 tokens for perplexity")
+        if t > self.header.seq_len:
+            raise ValueError(
+                f"{t} tokens exceed seqLen {self.header.seq_len}"
+            )
+        bad = max(tokens)
+        if bad >= self.header.vocab_size:
+            # a tokenizer/model vocab mismatch would otherwise score
+            # out-of-range rows as NaN (gather clamps silently on device)
+            raise ValueError(
+                f"token id {bad} out of range for model vocab "
+                f"{self.header.vocab_size} (tokenizer/model mismatch?)"
+            )
+        self.reset()
+        nll_sum = 0.0
+        p = 0
+        remaining = list(tokens)
+        while remaining:
+            bucket = self._bucket_for(len(remaining), p)
+            width = min(bucket, len(remaining))
+            chunk = remaining[:width] + [0] * (bucket - width)
+            remaining = remaining[width:]
+            # row j (global index p+j) is scored against token p+j+1; the
+            # final token and padding rows are masked out
+            targets = [
+                tokens[p + j + 1] if (p + j + 1 < t and j < width) else 0
+                for j in range(bucket)
+            ]
+            mask = [
+                1.0 if (p + j + 1 < t and j < width) else 0.0
+                for j in range(bucket)
+            ]
+            arr = jax.device_put(
+                jnp.asarray([chunk] * self.batch_size, jnp.int32),
+                self._token_sharding,
+            )
+            tgt = jax.device_put(
+                jnp.asarray([targets] * self.batch_size, jnp.int32),
+                self._token_sharding,
+            )
+            msk = jax.device_put(
+                jnp.asarray([mask] * self.batch_size, jnp.float32),
+                self._token_sharding,
+            )
+            score = self._score_fn(
+                bucket, window=self._attn_window(p + bucket)
+            )
+            part, self.cache = score(
+                self.params, arr, tgt, msk, self.cache, jnp.int32(p)
+            )
+            nll_sum += float(np.asarray(part))
+            p += width
+        n_scored = t - 1
+        nll = nll_sum / n_scored
+        return nll, float(np.exp(nll)), n_scored
+
     def _bucket_for(self, n: int, pos: int) -> int:
         """Smallest bucket covering n tokens whose PADDED extent still fits
         in the cache (dynamic_update_slice clamps silently if pos+bucket >

@@ -212,6 +212,39 @@ def test_quant_rejects_non_q40(tmp_path):
         InferenceEngine(mp, tp=1, dtype=jnp.float32, weight_format="q40")
 
 
+def test_perplexity_chunk_size_invariant(tiny_model):
+    """Chunked on-device scoring must be invariant to the prefill bucket
+    shape (the chunks see earlier chunks only through the KV cache), and
+    match a direct full-prompt numpy computation of the NLL."""
+    mp, _ = tiny_model
+    prompt = [(i * 7 + 3) % 256 for i in range(50)]
+
+    ppls = []
+    for buckets in [(4,), (8, 32), (50,)]:
+        e = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                            prefill_buckets=buckets)
+        nll, ppl, n = e.perplexity(prompt)
+        assert n == len(prompt) - 1
+        ppls.append(ppl)
+    assert abs(ppls[0] - ppls[1]) < 1e-3 and abs(ppls[0] - ppls[2]) < 1e-3, ppls
+
+    # oracle: single un-chunked forward, host softmax
+    from dllama_tpu.models import forward
+
+    e = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    cache = e._fresh_cache()
+    arr = jnp.asarray([prompt], dtype=jnp.int32)
+    logits, _ = forward(e.params, e.header, arr, jnp.int32(0), cache,
+                        mesh=e.mesh)
+    lg = np.asarray(logits, np.float32)[0]
+    mx = lg.max(-1, keepdims=True)
+    logprobs = lg - mx - np.log(np.exp(lg - mx).sum(-1, keepdims=True))
+    nll_ref = -np.mean(
+        [logprobs[i, prompt[i + 1]] for i in range(len(prompt) - 1)]
+    )
+    assert abs(ppls[0] - float(np.exp(nll_ref))) < 1e-3
+
+
 def test_telemetry_report_and_ici():
     from dllama_tpu.models.synthetic import make_header, random_params
     from dllama_tpu.models import init_kv_cache

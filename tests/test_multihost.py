@@ -1,0 +1,92 @@
+"""Multi-host (DCN) bootstrap test: two real OS processes join through
+`initialize_multihost` (the SPMD replacement for the reference's
+root/worker TCP handshake, src/nn/nn-network.cpp:295-379) and run a
+cross-process psum over a global mesh — the collective rides the
+distributed runtime's data plane (Gloo on CPU; ICI/DCN on TPU pods),
+exactly the path a v5e-16+ pod launch takes."""
+
+import os
+import socket
+import subprocess
+import sys
+
+from helpers import REPO_ROOT
+
+_WORKER = r"""
+import sys
+sys.path.insert(0, sys.argv[3])
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+from dllama_tpu.parallel.mesh import initialize_multihost
+initialize_multihost(
+    coordinator_address=f"127.0.0.1:{sys.argv[2]}", num_processes=2,
+    process_id=pid,
+)
+import numpy as np
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 2, jax.device_count()
+
+mesh = Mesh(np.asarray(jax.devices()).reshape(2), ("tp",))
+# each process contributes its own shard (value = pid + 1); the psum must
+# see both shards -> 3.0 everywhere
+garr = jax.make_array_from_single_device_arrays(
+    (16,), NamedSharding(mesh, P("tp")),
+    [jax.device_put(np.full(8, pid + 1.0, np.float32),
+                    jax.local_devices()[0])],
+)
+out = jax.jit(
+    shard_map(lambda a: jax.lax.psum(a, "tp"), mesh=mesh,
+              in_specs=P("tp"), out_specs=P("tp"))
+)(garr)
+local = np.asarray(out.addressable_shards[0].data)
+assert np.allclose(local, 3.0), local
+print(f"proc {pid} psum ok", flush=True)
+"""
+
+
+def test_two_process_multihost_psum(tmp_path):
+    port = _free_port()
+    script = tmp_path / "mh_worker.py"
+    script.write_text(_WORKER)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        # one local device per process (the conftest's 8-device flag would
+        # otherwise leak in and give 16 global devices)
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+    )
+    # the coordinator (process 0) must be up before/while 1 dials in;
+    # launch both and join
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port), REPO_ROOT],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out}"
+        assert "psum ok" in out, out
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
