@@ -36,25 +36,45 @@ from .sampler import Sampler
 DEFAULT_PREFILL_BUCKETS = (1, 8, 32, 128, 512)
 
 
-def _sample_on_device(logits, temperature, topp, key):
-    """Temperature + top-p sampling on device, [B, V] f32 -> [B] int32.
+def _topp_mask(probs, topp):
+    """Top-p nucleus mask on device, [B, V] probs -> masked probs.
 
     Same selection rule as the host sampler (keep the smallest prefix of
     descending probs whose cumulative mass exceeds topp, including the
-    crossing token — reference: sample_topp, tokenizer.cpp:426-467) but
-    driven by the JAX PRNG instead of xorshift: on-device sampling keeps
-    the decode loop free of per-token host round trips. Seeded runs are
-    reproducible, just under a different (documented) RNG than the
-    reference.
+    crossing token — reference: sample_topp, tokenizer.cpp:426-467);
+    topp outside (0, 1) keeps the full distribution, matching the host
+    sampler's sample_mult fallthrough, and a cumsum that never crosses
+    (f32 rounding at topp near 1) keeps everything, matching the host's
+    empty-`over` branch. Split out so its support set can be
+    equivalence-tested against the host rule (tests/test_engine.py).
+    Known divergence: exact prob TIES at the nucleus boundary keep all
+    tied tokens here (threshold rule) where the host keeps only those
+    before its sort's crossing point — the host's own tie order is
+    sort-dependent, so the boundary choice is arbitrary in both.
     """
-    probs = jax.nn.softmax(logits / temperature, axis=-1)
     sorted_probs = jnp.sort(probs, axis=-1)[..., ::-1]
     csum = jnp.cumsum(sorted_probs, axis=-1)
-    cross = jnp.argmax(csum > topp, axis=-1)
+    crossed = csum > topp
+    cross = jnp.where(
+        jnp.any(crossed, axis=-1),
+        jnp.argmax(crossed, axis=-1),
+        probs.shape[-1] - 1,
+    )
     thresh = jnp.take_along_axis(sorted_probs, cross[..., None], axis=-1)
     topp_valid = jnp.logical_and(topp > 0.0, topp < 1.0)
     masked = jnp.where(probs >= thresh, probs, 0.0)
-    probs = jnp.where(topp_valid, masked, probs)
+    return jnp.where(topp_valid, masked, probs)
+
+
+def _sample_on_device(logits, temperature, topp, key):
+    """Temperature + top-p sampling on device, [B, V] f32 -> [B] int32.
+
+    Host-sampler selection rule (see _topp_mask) driven by the JAX PRNG
+    instead of xorshift: on-device sampling keeps the decode loop free of
+    per-token host round trips. Seeded runs are reproducible, just under a
+    different (documented) RNG than the reference.
+    """
+    probs = _topp_mask(jax.nn.softmax(logits / temperature, axis=-1), topp)
     return jax.random.categorical(
         key, jnp.log(probs + 1e-30), axis=-1
     ).astype(jnp.int32)

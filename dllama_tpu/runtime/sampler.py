@@ -50,20 +50,30 @@ def sample_mult(probs: np.ndarray, coin: float) -> int:
     return min(idx, len(probs) - 1)
 
 
-def sample_topp(probs: np.ndarray, topp: float, coin: float) -> int:
-    """Nucleus sampling with the reference's cutoff pre-filter
-    (src/tokenizer.cpp:426-467)."""
+def topp_support(probs: np.ndarray, topp: float) -> tuple[np.ndarray, np.ndarray]:
+    """Nucleus candidate set: (token ids in descending-prob order, their
+    cumulative sums). Keeps the smallest prefix whose mass exceeds topp,
+    including the crossing token, over the reference's cutoff pre-filter
+    (src/tokenizer.cpp:426-467); the whole filtered set when the f32
+    cumsum never crosses. Shared by sample_topp and the device-mask
+    equivalence test."""
     n = len(probs)
     cutoff = (1.0 - topp) / (n - 1)
     idx = np.nonzero(probs >= cutoff)[0]
     # descending sort; stable to make ties deterministic
     order = idx[np.argsort(-probs[idx], kind="stable")]
-    p = probs[order]
-    csum = np.cumsum(p, dtype=np.float32)
+    csum = np.cumsum(probs[order], dtype=np.float32)
     over = np.nonzero(csum > topp)[0]
     last = int(over[0]) if len(over) else len(order) - 1
+    return order[: last + 1], csum[: last + 1]
+
+
+def sample_topp(probs: np.ndarray, topp: float, coin: float) -> int:
+    """Nucleus sampling (reference: src/tokenizer.cpp:426-467)."""
+    order, csum = topp_support(probs, topp)
+    last = len(order) - 1
     r = coin * csum[last]
-    pick = int(np.searchsorted(csum[: last + 1], r, side="right"))
+    pick = int(np.searchsorted(csum, r, side="right"))
     pick = min(pick, last)
     return int(order[pick])
 

@@ -5,7 +5,7 @@
 #
 # Usage: ./macbeth.sh <model.m> <tokenizer.t> [steps]
 
-set -e
+set -e -o pipefail
 MODEL=${1:?usage: macbeth.sh <model.m> <tokenizer.t> [steps]}
 TOK=${2:?tokenizer path required}
 STEPS=${3:-128}
@@ -24,6 +24,10 @@ run() {
 
 A=$(run)
 B=$(run)
+if [ -z "$A" ]; then
+    echo "❌ no output produced (CLI failed or nothing decoded — is steps > prompt length?)"
+    exit 1
+fi
 if [ "$A" = "$B" ]; then
     echo "✅ deterministic over $STEPS steps"
 else
