@@ -36,6 +36,10 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--nthreads", type=int, default=1, help="accepted for CLI parity; XLA owns threading")
     p.add_argument("--net-turbo", type=int, default=1, help="accepted for CLI parity")
     p.add_argument("--nbatches", "--n-batches", type=int, default=32, dest="nbatches", help="prefill chunk size")
+    p.add_argument("--batch-size", type=int, default=1, dest="batch_size",
+                   help="decode lanes: >1 lets the API server stream "
+                        "multiple requests concurrently (per-lane "
+                        "positions over the dp batch axis)")
     p.add_argument("--tp", type=int, default=0, help="tensor-parallel chips (default: all)")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel chips: shard the KV cache's "
@@ -120,6 +124,7 @@ def load_engine(args):
         seed=args.seed,
         prefill_buckets=tuple(sorted({1, args.nbatches, 512})),
         weight_format=args.weight_format,
+        batch_size=getattr(args, "batch_size", 1),
     )
     h = engine.header
     print(f"💡 Arch: {h.arch.name}")

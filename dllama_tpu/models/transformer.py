@@ -87,13 +87,23 @@ def _attention_tp(
     run per-shard under shard_map with no collectives.
     """
     b, t = q.shape[0], q.shape[1]
+    per_lane = jnp.ndim(pos) == 1
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        if per_lane:
+            raise NotImplementedError(
+                "per-lane positions are not supported with sp > 1"
+            )
         return _attention_sp(q, k_cache, v_cache, pos, head_dim, mesh)
     on_tpu = jax.default_backend() == "tpu"
     s = k_cache.shape[1]
     if on_tpu and t == 1 and pick_decode_block(s) is not None:
-        kernel = flash_decode
-    elif on_tpu and t >= 8 and pick_flash_blocks(t, s) is not None:
+        kernel = flash_decode  # handles scalar and per-lane pos
+    elif (
+        on_tpu
+        and not per_lane
+        and t >= 8
+        and pick_flash_blocks(t, s) is not None
+    ):
         kernel = flash_attention
     else:
         return _attention(q, k_cache, v_cache, pos, head_dim)
@@ -106,10 +116,11 @@ def _attention_tp(
 
         spec_q = P("dp", None, "tp", None)
         spec_kv = P("dp", None, "tp", None)
+        pos_spec = P("dp") if per_lane else P()
         out = shard_map(
             lambda qq, kk, vv, pp: kernel(qq, kk, vv, pp),
             mesh=mesh,
-            in_specs=(spec_q, spec_kv, spec_kv, P()),
+            in_specs=(spec_q, spec_kv, spec_kv, pos_spec),
             out_specs=spec_q,
             check_vma=False,
         )(q, k_cache, v_cache, pos)
@@ -435,11 +446,31 @@ def forward(
     interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
     act = silu if h.hidden_act == HiddenAct.SILU else gelu
     is_qwen3 = h.arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE)
+    # `pos` may be a [B] vector: each batch lane decodes at its own
+    # position (independent request lanes — the continuous-batching
+    # surface the reference's single-stream loop lacks)
+    per_lane = jnp.ndim(pos) == 1
 
     x = params["embed"][tokens]  # [B, T, D] (reference: OP_EMBEDDING)
 
-    cos = lax.dynamic_slice_in_dim(params["rope_cos"], pos, t, axis=0)  # [T, hd/2]
-    sin = lax.dynamic_slice_in_dim(params["rope_sin"], pos, t, axis=0)
+    if per_lane:
+        positions = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+        cos = params["rope_cos"][positions]  # [B, T, hd/2]
+        sin = params["rope_sin"][positions]
+    else:
+        cos = lax.dynamic_slice_in_dim(params["rope_cos"], pos, t, axis=0)
+        sin = lax.dynamic_slice_in_dim(params["rope_sin"], pos, t, axis=0)
+
+    def _cache_append(cache_l, val):
+        """Write the chunk at each lane's position (reference: OP_SHIFT,
+        src/nn/nn-cpu-ops.cpp:1419-1441) -> dynamic_update_slice, vmapped
+        over lanes when positions differ."""
+        val = val.astype(cache_l.dtype)
+        if per_lane:
+            return jax.vmap(
+                lambda c, u, p: lax.dynamic_update_slice_in_dim(c, u, p, axis=0)
+            )(cache_l, val, pos)
+        return lax.dynamic_update_slice_in_dim(cache_l, val, pos, axis=1)
 
     def layer_step(x, layer):
         lp, k_cache_l, v_cache_l = layer
@@ -455,14 +486,8 @@ def forward(
         q = apply_rope(q, cos, sin, interleaved)
         k = apply_rope(k, cos, sin, interleaved)
 
-        # KV-cache append at position (reference: OP_SHIFT,
-        # src/nn/nn-cpu-ops.cpp:1419-1441) -> dynamic_update_slice.
-        k_cache_l = lax.dynamic_update_slice_in_dim(
-            k_cache_l, k.astype(k_cache_l.dtype), pos, axis=1
-        )
-        v_cache_l = lax.dynamic_update_slice_in_dim(
-            v_cache_l, v.astype(v_cache_l.dtype), pos, axis=1
-        )
+        k_cache_l = _cache_append(k_cache_l, k)
+        v_cache_l = _cache_append(v_cache_l, v)
 
         if attn_window and attn_window < k_cache_l.shape[1]:
             k_view = k_cache_l[:, :attn_window]

@@ -228,7 +228,7 @@ def flash_attention_stats(
 
 
 def _flash_decode_kernel(
-    pos_ref,  # SMEM scalar prefetch: [1] int32 (absolute query position)
+    pos_ref,  # SMEM scalar prefetch: [B] int32 (per-lane query positions)
     q_ref,  # [1, G, hd] (the G query heads sharing this KV head)
     k_ref,  # [1, bs, 1, hd] — a native-layout cache tile (no pre-transpose)
     v_ref,  # [1, bs, 1, hd]
@@ -239,6 +239,7 @@ def _flash_decode_kernel(
     *,
     block_s: int,
     n_s: int,
+    n_kv_heads: int,
     scale: float,
 ):
     """T=1 decode step: one query token per lane group, online softmax over
@@ -247,9 +248,11 @@ def _flash_decode_kernel(
     HBM->VMEM copy when the block index repeats), so per-step cache reads
     are proportional to pos — the O(pos) property of the reference's
     decode attention (src/nn/nn-cpu-ops.cpp:753-788) — while the compiled
-    program covers the whole cache (no per-window recompiles)."""
+    program covers the whole cache (no per-window recompiles). Positions
+    are per LANE (pos_ref[b]), so independent decode lanes at different
+    depths each read only their own ~pos rows."""
     si = pl.program_id(1)
-    pos = pos_ref[0]
+    pos = pos_ref[pl.program_id(0) // n_kv_heads]
 
     @pl.when(si == 0)
     def _init():
@@ -314,7 +317,7 @@ def flash_decode(
     q: jnp.ndarray,  # [B, 1, H, hd]
     k_cache: jnp.ndarray,  # [B, S, KH, hd]
     v_cache: jnp.ndarray,  # [B, S, KH, hd]
-    pos: jnp.ndarray,  # scalar int32
+    pos: jnp.ndarray,  # scalar int32, or [B] per-lane positions
     block_s: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:
@@ -347,24 +350,30 @@ def flash_decode(
 
     # [B, 1, H, hd] -> [B * KH, G, hd] (pure reshape: T=1, no data movement)
     qt = q.reshape(b, kh, g, hd).reshape(b * kh, g, hd)
-    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    pos_arr = jnp.broadcast_to(
+        jnp.atleast_1d(jnp.asarray(pos, jnp.int32)), (b,)
+    )
 
     def q_map(bk, si, pos_ref):
         return (bk, 0, 0)
 
     def kv_map(bk, si, pos_ref):
         # clamp: revisiting the same block index elides the DMA, so blocks
-        # beyond pos cost no HBM traffic
+        # beyond this lane's pos cost no HBM traffic
         return (
             bk // kh,
-            jnp.minimum(si, pos_ref[0] // block_s),
+            jnp.minimum(si, pos_ref[bk // kh] // block_s),
             bk % kh,
             0,
         )
 
     out = pl.pallas_call(
         functools.partial(
-            _flash_decode_kernel, block_s=block_s, n_s=n_s, scale=scale
+            _flash_decode_kernel,
+            block_s=block_s,
+            n_s=n_s,
+            n_kv_heads=kh,
+            scale=scale,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,

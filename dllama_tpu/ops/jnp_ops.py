@@ -110,15 +110,17 @@ def apply_rope(
     """Rotate ``x`` of shape [..., T, nHeads, headDim] by position.
 
     ``cos``/``sin`` are [T, headDim//2] rows for the absolute positions of
-    the T axis. ``interleaved=True`` pairs (2j, 2j+1) — the llama layout the
-    converter permutes q/k for (reference: ropeLlama_F32,
-    src/nn/nn-cpu-ops.cpp:843-863); ``False`` pairs (j, j+headDim/2) — the
-    falcon/neox layout used by Qwen3 (src/nn/nn-cpu-ops.cpp:865-885).
+    the T axis — or [B, T, headDim//2] when lanes sit at different
+    positions (per-lane decode). ``interleaved=True`` pairs (2j, 2j+1) —
+    the llama layout the converter permutes q/k for (reference:
+    ropeLlama_F32, src/nn/nn-cpu-ops.cpp:843-863); ``False`` pairs
+    (j, j+headDim/2) — the falcon/neox layout used by Qwen3
+    (src/nn/nn-cpu-ops.cpp:865-885).
     """
     dtype = x.dtype
     xf = x.astype(jnp.float32)
-    c = cos[:, None, :]  # [T, 1, half]
-    s = sin[:, None, :]
+    c = cos[..., :, None, :]  # [(B,) T, 1, half]
+    s = sin[..., :, None, :]
     if interleaved:
         x0 = xf[..., 0::2]
         x1 = xf[..., 1::2]
@@ -142,14 +144,15 @@ def attention_stats(
     q: jnp.ndarray,  # [B, Tq, H, hd]
     k: jnp.ndarray,  # [B, Ts, KH, hd]
     v: jnp.ndarray,  # [B, Ts, KH, hd]
-    q_pos0,  # scalar: absolute position of q[:, 0]
+    q_pos0,  # scalar or [B]: absolute position of q[:, 0] (per lane)
     s_pos0,  # scalar: absolute position of k[:, 0]
 ):
     """Causal GQA attention partial state (unnormalized acc, running max m,
     denominator l) in f32 — the single source of the reference's
     multiheadAtt_F32 math (src/nn/nn-cpu-ops.cpp:753-788). Dense attention
     normalizes it directly; ring attention merges several of these across
-    sequence shards."""
+    sequence shards. A vector ``q_pos0`` gives each batch lane its own
+    position (independent decode lanes)."""
     b, tq, h, hd = q.shape
     ts, kh = k.shape[1], k.shape[2]
     g = h // kh
@@ -157,10 +160,11 @@ def attention_stats(
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
     scores = jnp.einsum("btkgh,bskh->bkgts", qf, kf) / jnp.sqrt(jnp.float32(hd))
-    q_pos = q_pos0 + jnp.arange(tq, dtype=jnp.int32)
+    q_pos0_arr = jnp.atleast_1d(jnp.asarray(q_pos0, jnp.int32))  # [1] or [B]
+    q_pos = q_pos0_arr[:, None] + jnp.arange(tq, dtype=jnp.int32)[None, :]
     s_pos = s_pos0 + jnp.arange(ts, dtype=jnp.int32)
-    mask = s_pos[None, :] <= q_pos[:, None]
-    scores = jnp.where(mask[None, None, None, :, :], scores, _NEG_INF)
+    mask = s_pos[None, None, :] <= q_pos[:, :, None]  # [1 or B, tq, ts]
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
     m = jnp.max(scores, axis=-1)  # [b, kh, g, tq]
     p = jnp.exp(scores - m[..., None])
     # fully-masked rows (query before every key in this shard) -> zero

@@ -11,14 +11,18 @@ Capability port of the reference's `dllama-api` (src/dllama-api.cpp):
   (src/dllama-api.cpp:298-343).
 
 The reference hand-rolls an HTTP/1.1 server over raw sockets; here Python's
-stdlib ThreadingHTTPServer carries the protocol and a lock serializes model
-access (the reference's accept loop is single-threaded, same effective
-policy — one generation at a time, but connections don't get refused).
+stdlib ThreadingHTTPServer carries the protocol. With a batch_size == 1
+engine a lock serializes model access (the reference's single-threaded
+accept loop, same effective policy); with batch_size > 1 a LaneScheduler
+serves requests CONCURRENTLY over the engine's batch lanes — per-lane
+parked prefill admits new requests while other conversations stream, a
+capability the reference does not have.
 """
 
 from __future__ import annotations
 
 import json
+import queue
 import threading
 import time
 import uuid
@@ -97,6 +101,203 @@ class InferenceParams:
     stop: list[str] = field(default_factory=list)
 
 
+class LaneJob:
+    """One admitted request: the scheduler thread produces events, the
+    HTTP handler thread consumes them. Events: ("delta", str),
+    ("done", finish_reason), ("error", message). The handler sets
+    `cancelled` when the client disconnects; the scheduler then frees the
+    lane instead of decoding to max_pos for nobody."""
+
+    def __init__(self, params: InferenceParams):
+        self.params = params
+        self.events: queue.Queue = queue.Queue()
+        self.n_prompt_tokens = 0
+        self.n_completion = 0
+        self.buffer = ""
+        self.cancelled = False
+
+
+@dataclass
+class _LaneState:
+    job: LaneJob
+    pos: int
+    token: int
+    max_pos: int
+    detector: EosDetector
+    decoder: object  # tokenizer StreamDecoder
+    temperature: float
+    top_p: float
+
+
+class LaneScheduler:
+    """Continuous-batching loop over the engine's batch lanes.
+
+    A central thread owns ALL engine calls: it admits pending requests
+    into free lanes (per-lane parked prefill keeps the other lanes'
+    caches intact) and steps every active lane together in shared decode
+    blocks, each lane at its own position with its own sampling settings.
+    This is the concurrency surface the reference's single-threaded
+    accept loop (src/dllama-api.cpp:563-574) lacks entirely: N clients
+    stream simultaneously at roughly the single-stream decode rate.
+
+    The NaiveCache prompt-prefix reuse is intentionally not used here —
+    lanes are recycled across unrelated clients, so every request
+    prefills from position 0 in its lane (the batch_size == 1 path keeps
+    the cache behavior).
+    """
+
+    def __init__(self, state: "ApiState", block_size: int = 8):
+        self.state = state
+        self.engine = state.engine
+        self.block_size = block_size
+        self.lanes: list[_LaneState | None] = [None] * self.engine.batch_size
+        self.pending: list[LaneJob] = []
+        self.cv = threading.Condition()
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def submit(self, params: InferenceParams) -> LaneJob:
+        job = LaneJob(params)
+        with self.cv:
+            self.pending.append(job)
+            self.cv.notify()
+        return job
+
+    # -- scheduler thread --------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self.cv:
+                while not self.pending and not any(self.lanes):
+                    self.cv.wait()
+                admissions = []
+                for lane in range(len(self.lanes)):
+                    if not self.pending:
+                        break
+                    if self.lanes[lane] is None:
+                        admissions.append((lane, self.pending.pop(0)))
+            for lane, job in admissions:
+                self._admit(lane, job)
+            if any(self.lanes):
+                try:
+                    self._step_block()
+                except Exception as e:
+                    # the scheduler thread must survive any engine error:
+                    # fail every in-flight request loudly and keep serving
+                    # (the reference's crash-retry loop plays this role
+                    # for its single stream, dllama-api.cpp:616-628)
+                    for lane in range(len(self.lanes)):
+                        if self.lanes[lane] is not None:
+                            self.lanes[lane].job.events.put(("error", str(e)))
+                            self.lanes[lane] = None
+                    with self.cv:
+                        self.cv.notify_all()
+
+    def _admit(self, lane: int, job: LaneJob) -> None:
+        state, engine, tok = self.state, self.engine, self.state.tokenizer
+        p = job.params
+        try:
+            items = [ChatItem(m.role, m.content) for m in p.messages]
+            prompt = state.template.generate(items, append_generation_prompt=True)
+            tokens = tok.encode(
+                prompt.content, is_start=True, add_special_tokens=True
+            )
+            seq_len = engine.header.seq_len
+            prompt_end = len(tokens) - 1
+            if prompt_end >= seq_len:
+                raise ValueError(
+                    f"prompt of {len(tokens)} tokens exceeds seqLen {seq_len}"
+                )
+            max_pos = (
+                min(prompt_end + p.max_tokens, seq_len)
+                if p.max_tokens > 0
+                else seq_len
+            )
+            # `seed` is IGNORED in lane mode: the on-device RNG stream is
+            # shared across lanes, so reseeding mid-flight would perturb
+            # other clients' in-progress sampled generations (and the
+            # seeded request still wouldn't be reproducible — its draws
+            # depend on which other lanes are active). batch_size == 1
+            # keeps full seed semantics.
+            engine.prefill_lane(lane, tokens)
+            if prompt.public_prompt:
+                job.buffer += prompt.public_prompt
+                job.events.put(("delta", prompt.public_prompt))
+            job.n_prompt_tokens = len(tokens)
+            detector = EosDetector(
+                tok.eos_token_ids,
+                state.stops if not p.stop else p.stop,
+                padding_left=state.max_stop_len,
+                padding_right=state.max_stop_len,
+            )
+            self.lanes[lane] = _LaneState(
+                job=job,
+                pos=prompt_end,
+                token=tokens[-1],
+                max_pos=max_pos,
+                detector=detector,
+                decoder=tok.stream_decoder(),
+                temperature=p.temperature,
+                top_p=p.top_p,
+            )
+        except Exception as e:
+            job.events.put(("error", str(e)))
+            self.lanes[lane] = None
+
+    def _finish(self, lane: int, reason: str) -> None:
+        ls = self.lanes[lane]
+        ls.job.events.put(("done", reason))
+        self.lanes[lane] = None
+        with self.cv:
+            self.cv.notify()
+
+    def _step_block(self) -> None:
+        b = len(self.lanes)
+        # free lanes whose client went away before paying for more decode
+        for lane in range(b):
+            ls = self.lanes[lane]
+            if ls is not None and ls.job.cancelled:
+                self._finish(lane, "cancelled")
+        active = [ls is not None for ls in self.lanes]
+        if not any(active):
+            return
+        tokens = [ls.token if ls else 0 for ls in self.lanes]
+        pos = [ls.pos if ls else 0 for ls in self.lanes]
+        temps = [ls.temperature if ls else 0.0 for ls in self.lanes]
+        topps = [ls.top_p if ls else 1.0 for ls in self.lanes]
+        rows = self.engine.decode_lanes(
+            tokens, pos, self.block_size, active, temps, topps
+        )
+        if not rows:
+            for lane in range(b):
+                if self.lanes[lane] is not None:
+                    self._finish(lane, "length")
+            return
+        for row in rows:
+            for lane in range(b):
+                ls = self.lanes[lane]
+                if ls is None or not active[lane]:
+                    continue
+                t = row[lane]
+                ls.pos += 1
+                ls.token = t
+                ls.job.n_completion += 1
+                piece = ls.decoder.decode(t)
+                eos_type = ls.detector.append(t, piece)
+                if eos_type in (EosResult.NOT_EOS, EosResult.EOS):
+                    delta = ls.detector.get_delta()
+                    if delta:
+                        ls.job.buffer += delta
+                        ls.job.events.put(("delta", delta))
+                    ls.detector.reset()
+                if eos_type == EosResult.EOS:
+                    active[lane] = False
+                    self._finish(lane, "stop")
+                elif ls.pos >= ls.max_pos:
+                    active[lane] = False
+                    self._finish(lane, "length")
+
+
 class ApiState:
     """Engine + tokenizer + conversation cache shared across requests."""
 
@@ -126,6 +327,13 @@ class ApiState:
         )
         self.naive_cache = NaiveCache()
         self.lock = threading.Lock()
+        # batch_size > 1 engines serve requests CONCURRENTLY over the
+        # engine's batch lanes (the reference's accept loop — and the
+        # batch_size == 1 path here — serves one request at a time)
+        self.scheduler = (
+            LaneScheduler(self) if engine.batch_size > 1 and engine.sp == 1
+            else None
+        )
 
     # -- completion ------------------------------------------------------
 
@@ -230,24 +438,48 @@ class ApiState:
                 self.naive_cache.push(NaiveCacheItem(prompt_end_pos, m))
             self.naive_cache.push(NaiveCacheItem(pos, message))
 
-        return {
-            "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
-            "object": "chat.completion",
-            "created": int(time.time()),
-            "model": self.model_name,
-            "choices": [
-                {
-                    "index": 0,
-                    "message": {"role": "assistant", "content": buffer},
-                    "finish_reason": "stop" if hit_eos else "length",
-                }
-            ],
-            "usage": {
-                "prompt_tokens": n_prompt_tokens,
-                "completion_tokens": n_completion,
-                "total_tokens": n_prompt_tokens + n_completion,
-            },
-        }
+        return _completion_response(
+            self,
+            buffer,
+            "stop" if hit_eos else "length",
+            n_prompt_tokens,
+            n_completion,
+        )
+
+
+def _completion_response(
+    state: "ApiState",
+    content: str,
+    finish_reason: str,
+    n_prompt: int,
+    n_completion: int,
+) -> dict:
+    """The chat.completion response body, shared by the serialized and
+    lane-scheduled serving paths."""
+    return {
+        "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": state.model_name,
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": content},
+                "finish_reason": finish_reason,
+            }
+        ],
+        "usage": {
+            "prompt_tokens": n_prompt,
+            "completion_tokens": n_completion,
+            "total_tokens": n_prompt + n_completion,
+        },
+    }
+
+
+def _sse_write(wfile, data: str) -> None:
+    """One HTTP-chunked SSE frame (shared by both streaming paths)."""
+    raw = data.encode("utf-8")
+    wfile.write(f"{len(raw):x}\r\n".encode() + raw + b"\r\n")
 
 
 def _chunk_payload(
@@ -324,6 +556,9 @@ def make_handler(state: ApiState):
                 self._json({"error": {"message": f"bad request: {e}"}}, 400)
                 return
 
+            if state.scheduler is not None:
+                self._complete_lanes(params)
+                return
             with state.lock:
                 if params.stream:
                     self._stream(params)
@@ -338,16 +573,82 @@ def make_handler(state: ApiState):
                         return
                     self._json(response)
 
-        def _stream(self, params: InferenceParams) -> None:
+        def _complete_lanes(self, params: InferenceParams) -> None:
+            """Concurrent path: submit to the lane scheduler and relay its
+            event stream; many handler threads can sit here at once."""
+            job = state.scheduler.submit(params)
+            if params.stream:
+                self._sse_headers()
+                finish_reason = "stop"
+                errored = False
+                try:
+                    while True:
+                        kind, payload = job.events.get()
+                        if kind == "delta":
+                            chunk = _chunk_payload(state, payload, stop=False)
+                            _sse_write(
+                                self.wfile, f"data: {json.dumps(chunk)}\r\n\r\n"
+                            )
+                        elif kind == "error":
+                            _sse_write(
+                                self.wfile,
+                                "data: "
+                                + json.dumps({"error": {"message": payload}})
+                                + "\r\n\r\n",
+                            )
+                            errored = True
+                            break
+                        else:  # done
+                            finish_reason = payload
+                            break
+                    if not errored:
+                        _sse_write(
+                            self.wfile,
+                            "data: "
+                            + json.dumps(
+                                _chunk_payload(state, None, True, finish_reason)
+                            )
+                            + "\r\n\r\n",
+                        )
+                    _sse_write(self.wfile, "data: [DONE]\r\n\r\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    # client went away: tell the scheduler to stop paying
+                    # for this lane (the serialized path aborts via the
+                    # emit exception; this is the lane-mode equivalent)
+                    job.cancelled = True
+                return
+            finish_reason = "stop"
+            while True:
+                kind, payload = job.events.get()
+                if kind == "error":
+                    self._json({"error": {"message": payload}}, 500)
+                    return
+                if kind == "done":
+                    finish_reason = payload
+                    break
+            self._json(
+                _completion_response(
+                    state,
+                    job.buffer,
+                    finish_reason,
+                    job.n_prompt_tokens,
+                    job.n_completion,
+                )
+            )
+
+        def _sse_headers(self) -> None:
             self.send_response(200)
             self.send_header("Access-Control-Allow-Origin", "*")
             self.send_header("Content-Type", "text/event-stream; charset=utf-8")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
 
+        def _stream(self, params: InferenceParams) -> None:
+            self._sse_headers()
+
             def write_chunk(data: str) -> None:
-                raw = data.encode("utf-8")
-                self.wfile.write(f"{len(raw):x}\r\n".encode() + raw + b"\r\n")
+                _sse_write(self.wfile, data)
 
             def emit(delta: str) -> None:
                 payload = _chunk_payload(state, delta, stop=False)

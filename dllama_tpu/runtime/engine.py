@@ -37,7 +37,8 @@ DEFAULT_PREFILL_BUCKETS = (1, 8, 32, 128, 512)
 
 
 def _topp_mask(probs, topp):
-    """Top-p nucleus mask on device, [B, V] probs -> masked probs.
+    """Top-p nucleus mask on device, [B, V] probs -> masked probs; `topp`
+    is a scalar or a per-lane [B] vector.
 
     Same selection rule as the host sampler (keep the smallest prefix of
     descending probs whose cumulative mass exceeds topp, including the
@@ -52,32 +53,47 @@ def _topp_mask(probs, topp):
     before its sort's crossing point — the host's own tie order is
     sort-dependent, so the boundary choice is arbitrary in both.
     """
+    b = probs.shape[0]
+    topp_col = jnp.broadcast_to(
+        jnp.atleast_1d(jnp.asarray(topp, jnp.float32)), (b,)
+    )[:, None]
     sorted_probs = jnp.sort(probs, axis=-1)[..., ::-1]
     csum = jnp.cumsum(sorted_probs, axis=-1)
-    crossed = csum > topp
+    crossed = csum > topp_col
     cross = jnp.where(
         jnp.any(crossed, axis=-1),
         jnp.argmax(crossed, axis=-1),
         probs.shape[-1] - 1,
     )
     thresh = jnp.take_along_axis(sorted_probs, cross[..., None], axis=-1)
-    topp_valid = jnp.logical_and(topp > 0.0, topp < 1.0)
+    topp_valid = jnp.logical_and(topp_col > 0.0, topp_col < 1.0)
     masked = jnp.where(probs >= thresh, probs, 0.0)
     return jnp.where(topp_valid, masked, probs)
 
 
 def _sample_on_device(logits, temperature, topp, key):
-    """Temperature + top-p sampling on device, [B, V] f32 -> [B] int32.
+    """Temperature + top-p sampling on device, [B, V] f32 -> [B] int32;
+    `temperature`/`topp` may be per-lane [B] vectors, and lanes with
+    temperature == 0 take the greedy argmax — so one compiled program
+    serves any mix of sampling settings across lanes.
 
     Host-sampler selection rule (see _topp_mask) driven by the JAX PRNG
     instead of xorshift: on-device sampling keeps the decode loop free of
     per-token host round trips. Seeded runs are reproducible, just under a
     different (documented) RNG than the reference.
     """
-    probs = _topp_mask(jax.nn.softmax(logits / temperature, axis=-1), topp)
-    return jax.random.categorical(
+    b = logits.shape[0]
+    temp_col = jnp.broadcast_to(
+        jnp.atleast_1d(jnp.asarray(temperature, jnp.float32)), (b,)
+    )[:, None]
+    probs = _topp_mask(
+        jax.nn.softmax(logits / jnp.maximum(temp_col, 1e-6), axis=-1), topp
+    )
+    sampled = jax.random.categorical(
         key, jnp.log(probs + 1e-30), axis=-1
     ).astype(jnp.int32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(temp_col[:, 0] <= 0.0, greedy, sampled)
 
 
 @dataclasses.dataclass
@@ -174,6 +190,14 @@ class InferenceEngine:
             put=shard_params_put(self.mesh, self.header),
             weight_format=weight_format,
         )
+        # Per-lane serving: lanes park their cache writes in padding rows
+        # beyond seqLen while other lanes prefill/idle, so independent
+        # requests can occupy the batch lanes at different positions.
+        # Padding must cover the widest chunk a parked lane "writes".
+        self._lane_pad = (
+            max(self.prefill_buckets) if (batch_size > 1 and sp == 1) else 0
+        )
+        self._park = self.header.seq_len  # first padding row
         self._cache_sharding = {
             k: NamedSharding(self.mesh, spec)
             for k, spec in cache_specs(self.header, sp=sp > 1).items()
@@ -187,7 +211,12 @@ class InferenceEngine:
     # -- cache ---------------------------------------------------------------
 
     def _fresh_cache(self):
-        cache = init_kv_cache(self.header, self.batch_size, dtype=self.kv_dtype)
+        cache = init_kv_cache(
+            self.header,
+            self.batch_size,
+            dtype=self.kv_dtype,
+            seq_len=self.header.seq_len + self._lane_pad,
+        )
         return {
             k: jax.device_put(v, self._cache_sharding[k]) for k, v in cache.items()
         }
@@ -242,7 +271,8 @@ class InferenceEngine:
             return 0
         if (
             jax.default_backend() == "tpu"
-            and pick_decode_block(self.header.seq_len) is not None
+            and pick_decode_block(self.header.seq_len + self._lane_pad)
+            is not None
         ):
             return 0
         return self._attn_window(limit)
@@ -468,6 +498,181 @@ class InferenceEngine:
         nll = nll_sum / n_scored
         return nll, float(np.exp(nll)), n_scored
 
+    # -- per-lane serving (continuous-batching surface) ----------------------
+
+    def _require_lanes(self) -> None:
+        if self._lane_pad == 0:
+            raise ValueError(
+                "per-lane serving needs batch_size > 1 and sp == 1 "
+                "(lanes park their writes in cache padding rows)"
+            )
+
+    def _lane_prefill_fn(self, t: int, window: int = 0):
+        """Vector-position prefill step: each lane writes its chunk at its
+        own position; parked lanes write into the padding rows."""
+        key = ("lane_prefill", t, window)
+        if key in self._compiled:
+            return self._compiled[key]
+        h = self.header
+        mesh = self.mesh
+        precision = self._precision
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def step(params, tokens, cache, pos_vec):
+            ctx = (
+                jax.default_matmul_precision(precision)
+                if precision
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                _, cache = forward(
+                    params, h, tokens, pos_vec, cache, mesh=mesh,
+                    attn_window=window,
+                )
+            return cache
+
+        self._compiled[key] = step
+        return step
+
+    def prefill_lane(self, lane: int, tokens: list[int], pos0: int = 0) -> None:
+        """Prefill one lane's prompt (all but the last token) while every
+        other lane's cache rows stay untouched — their writes land in the
+        padding rows beyond seqLen, and causal masking hides those rows
+        from every real query. This is what lets the API server admit a
+        new request into a free lane while other lanes hold live
+        conversations (the reference's single-stream loop has no
+        equivalent)."""
+        self._require_lanes()
+        if not 0 <= lane < self.batch_size:
+            raise ValueError(f"lane {lane} out of range")
+        n = len(tokens)
+        if n < 1:
+            raise ValueError("empty prompt")
+        if pos0 + n - 1 > self.header.seq_len:
+            raise ValueError(
+                f"prompt of {n} tokens at pos {pos0} exceeds "
+                f"seqLen {self.header.seq_len}"
+            )
+        fills = tokens[:-1]
+        p = pos0
+        while fills:
+            bucket = self._bucket_for(len(fills), p)
+            width = min(bucket, len(fills))
+            chunk = fills[:width] + [0] * (bucket - width)
+            fills = fills[width:]
+            rows = [[0] * bucket for _ in range(self.batch_size)]
+            rows[lane] = chunk
+            posv = [self._park] * self.batch_size
+            posv[lane] = p
+            arr = jax.device_put(
+                jnp.asarray(rows, jnp.int32), self._token_sharding
+            )
+            pos_arr = jnp.asarray(posv, jnp.int32)
+            step = self._lane_prefill_fn(
+                bucket, window=self._attn_window(p + bucket)
+            )
+            self.cache = step(self.params, arr, self.cache, pos_arr)
+            p += width
+
+    def _lane_decode_fn(self, n_steps: int):
+        """Per-lane block decode: every lane advances from its own
+        position; inactive lanes are parked (fed token 0, writing only
+        padding rows). Sampling settings are per-lane vectors (temperature
+        0 = greedy argmax inside _sample_on_device), so ONE compiled
+        program serves any mix of requests. One host dispatch per block,
+        like decode_block."""
+        key = ("lane_block", n_steps)
+        if key in self._compiled:
+            return self._compiled[key]
+        h = self.header
+        mesh = self.mesh
+        precision = self._precision
+        park = self._park
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def block(params, token, cache, pos_vec, active, rng, temperature, topp):
+            def body(i, carry):
+                tok, cache, out = carry
+                cur = jnp.where(active, pos_vec + i, park)
+                ctx = (
+                    jax.default_matmul_precision(precision)
+                    if precision
+                    else contextlib.nullcontext()
+                )
+                with ctx:
+                    logits, cache = forward(
+                        params, h, tok, cur, cache, mesh=mesh
+                    )
+                last = logits[:, -1, :]
+                nxt = _sample_on_device(
+                    last, temperature, topp, jax.random.fold_in(rng, i)
+                )
+                nxt = jnp.where(active, nxt, 0).reshape(-1, 1)
+                out = lax.dynamic_update_index_in_dim(out, nxt[:, 0], i, axis=0)
+                return nxt, cache, out
+
+            out0 = jnp.zeros((n_steps, token.shape[0]), jnp.int32)
+            _, cache, out = lax.fori_loop(
+                0, n_steps, body, (token, cache, out0)
+            )
+            return out, cache
+
+        self._compiled[key] = block
+        return block
+
+    def decode_lanes(
+        self,
+        tokens: list[int],
+        pos: list[int],
+        n_steps: int,
+        active: list[bool] | None = None,
+        temperature: list[float] | None = None,
+        topp: list[float] | None = None,
+    ) -> list[list[int]]:
+        """Decode `n_steps` tokens on every ACTIVE lane in one device
+        dispatch, each lane at its own position (and its own sampling
+        settings — temperature 0 decodes that lane greedily). Returns
+        [n_steps][lanes] (parked lanes report token 0). `n_steps` is
+        clamped so no active lane runs past seqLen."""
+        self._require_lanes()
+        if len(tokens) != self.batch_size or len(pos) != self.batch_size:
+            raise ValueError("tokens/pos must have one entry per lane")
+        if active is None:
+            active = [True] * self.batch_size
+        live = [i for i, a in enumerate(active) if a]
+        if not live:
+            return []
+        n_steps = min(
+            n_steps, min(self.header.seq_len - pos[i] for i in live)
+        )
+        if n_steps <= 0:
+            return []
+        if temperature is None:
+            temperature = [self.temperature] * self.batch_size
+        if topp is None:
+            topp = [self.sampler.topp] * self.batch_size
+        arr = jax.device_put(
+            jnp.asarray([[t] for t in tokens], jnp.int32), self._token_sharding
+        )
+        pos_arr = jnp.asarray(pos, jnp.int32)
+        act_arr = jnp.asarray(active, jnp.bool_)
+        block = self._lane_decode_fn(n_steps)
+        self._rng_calls += 1
+        rng = jax.random.fold_in(
+            jax.random.fold_in(self._base_key, max(pos)), self._rng_calls
+        )
+        out, self.cache = block(
+            self.params,
+            arr,
+            self.cache,
+            pos_arr,
+            act_arr,
+            rng,
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(topp, jnp.float32),
+        )
+        return [[int(t) for t in row] for row in np.asarray(out)]
+
     def _bucket_for(self, n: int, pos: int) -> int:
         """Smallest bucket covering n tokens whose PADDED extent still fits
         in the cache (dynamic_update_slice clamps silently if pos+bucket >
@@ -628,31 +833,58 @@ class InferenceEngine:
         max_steps: int,
         block_size: int = 8,
     ) -> list[list[int]]:
-        """Decode independent same-length sequences, one per batch lane
-        (requires batch_size == len(prompts)). Greedy/sampled per the
-        engine temperature; returns per-lane token lists."""
+        """Decode independent sequences, one per batch lane (requires
+        batch_size == len(prompts)). Prompts may have DIFFERENT lengths:
+        each lane prefills separately (parked writes keep the others
+        intact) and decodes from its own position; `max_steps` is the
+        per-lane absolute position cap, matching `generate`. Greedy/
+        sampled per the engine temperature; returns per-lane token
+        lists."""
         if len(prompts) != self.batch_size:
             raise ValueError(
                 f"{len(prompts)} prompts for batch_size {self.batch_size}"
             )
         n = len(prompts[0])
-        if not all(len(p) == n for p in prompts):
-            raise ValueError("equal-length prompts required")
-        self._prefill_rows(prompts, 0)
-        pos = n - 1
-        tokens = [p[-1] for p in prompts]
-        outs: list[list[int]] = [[] for _ in prompts]
         max_pos = min(self.header.seq_len, max_steps)
-        while pos < max_pos:
-            nb = self._block_width(pos, block_size)
-            want = min(nb, max_pos - pos)
-            rows = self.decode_block(tokens, pos, nb)[:want]
+        if all(len(p) == n for p in prompts):
+            # synchronized fast path: one batched prefill, shared positions
+            self._prefill_rows(prompts, 0)
+            pos = n - 1
+            tokens = [p[-1] for p in prompts]
+            outs: list[list[int]] = [[] for _ in prompts]
+            while pos < max_pos:
+                nb = self._block_width(pos, block_size)
+                want = min(nb, max_pos - pos)
+                rows = self.decode_block(tokens, pos, nb)[:want]
+                if not rows:
+                    break
+                for row in rows:
+                    for lane, t in enumerate(row):
+                        outs[lane].append(t)
+                tokens = rows[-1]
+                pos += len(rows)
+            return outs
+
+        self._require_lanes()
+        for lane, p in enumerate(prompts):
+            if not p:
+                raise ValueError(f"lane {lane}: empty prompt")
+            self.prefill_lane(lane, p)
+        pos = [len(p) - 1 for p in prompts]
+        tokens = [p[-1] for p in prompts]
+        active = [pos[i] < max_pos for i in range(self.batch_size)]
+        outs = [[] for _ in prompts]
+        while any(active):
+            rows = self.decode_lanes(tokens, pos, block_size, active)
             if not rows:
                 break
             for row in rows:
                 for lane, t in enumerate(row):
-                    outs[lane].append(t)
-            tokens = rows[-1]
-            pos += len(rows)
+                    if active[lane]:
+                        outs[lane].append(t)
+                        pos[lane] += 1
+                        tokens[lane] = t
+                        if pos[lane] >= max_pos:
+                            active[lane] = False
         return outs
 

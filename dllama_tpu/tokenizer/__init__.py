@@ -1,4 +1,4 @@
-from .bpe import Tokenizer
+from .bpe import StreamDecoder, Tokenizer
 from .chat import (
     CHAT_TEMPLATE_NAMES,
     ChatItem,
@@ -9,6 +9,7 @@ from .chat import (
 )
 
 __all__ = [
+    "StreamDecoder",
     "Tokenizer",
     "CHAT_TEMPLATE_NAMES",
     "ChatItem",

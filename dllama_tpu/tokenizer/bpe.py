@@ -130,9 +130,10 @@ class Tokenizer:
         """Drop pending partial UTF-8 state (reference: resetDecoder)."""
         self._decoder.reset()
 
-    def decode(self, token: int) -> str | None:
-        """Streaming decode of one token; returns printable text accumulated so
-        far or None (reference: src/tokenizer.cpp:291-309)."""
+    def _decode_with(self, decoder, token: int) -> str | None:
+        """Streaming decode of one token against an explicit incremental
+        UTF-8 decoder; shared by the tokenizer's own stream and per-lane
+        StreamDecoders (reference: src/tokenizer.cpp:291-309)."""
         if token == self.bos_id:
             return None
         if not 0 <= token < self.vocab_size:
@@ -146,12 +147,24 @@ class Tokenizer:
             # Flush whatever partial sequence is pending (reference returns the
             # raw pending buffer; we replace the incomplete tail like the
             # recovery path would).
-            out = self._decoder.decode(b"", final=True)
-            self._decoder.reset()
+            out = decoder.decode(b"", final=True)
+            decoder.reset()
             return out if out else None
         piece = self.vocab[token]
-        out = self._decoder.decode(piece)
+        out = decoder.decode(piece)
         return out if out else None
+
+    def decode(self, token: int) -> str | None:
+        """Streaming decode of one token; returns printable text accumulated so
+        far or None (reference: src/tokenizer.cpp:291-309)."""
+        return self._decode_with(self._decoder, token)
+
+    def stream_decoder(self) -> "StreamDecoder":
+        """An INDEPENDENT streaming decoder over this vocab — one per
+        serving lane, so concurrent requests don't interleave their UTF-8
+        state (the tokenizer's own decode() keeps a single stream, like
+        the reference's single-request loop)."""
+        return StreamDecoder(self)
 
     def decode_tokens(self, tokens: list[int]) -> str:
         """Non-streaming convenience: decode a whole sequence. Starts from a
@@ -181,3 +194,18 @@ class Tokenizer:
             print(f"📄 EosId: {eos}")
         print(f"📄 RegularVocabSize: {self.regular_vocab_size}")
         print(f"📄 SpecialVocabSize: {self.vocab_size - self.regular_vocab_size}")
+
+
+class StreamDecoder:
+    """Per-lane streaming token decoder: same vocab/EOS rules as the
+    owning Tokenizer, independent incremental UTF-8 state."""
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._tok = tokenizer
+        self._decoder = codecs.getincrementaldecoder("utf-8")("replace")
+
+    def decode(self, token: int) -> str | None:
+        return self._tok._decode_with(self._decoder, token)
+
+    def reset(self) -> None:
+        self._decoder.reset()

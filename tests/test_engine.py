@@ -212,6 +212,56 @@ def test_quant_rejects_non_q40(tmp_path):
         InferenceEngine(mp, tp=1, dtype=jnp.float32, weight_format="q40")
 
 
+def test_generate_batch_unequal_prompts_match_single(tiny_model):
+    """Per-lane serving: three lanes with different prompt lengths decode
+    together (parked prefill + per-lane positions) and must reproduce each
+    prompt's single-stream greedy output exactly."""
+    mp, _ = tiny_model
+    prompts = [[1, 2, 3, 4], [9, 8, 7, 6, 5, 4, 3], [40, 41]]
+    singles = []
+    e1 = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    for p in prompts:
+        e1.reset()
+        out, _, _ = e1.generate(p, max_steps=20)
+        singles.append(out)
+    eb = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                         batch_size=3)
+    outs = eb.generate_batch(prompts, max_steps=20)
+    assert outs == singles, (outs, singles)
+
+
+def test_prefill_lane_preserves_other_lanes(tiny_model):
+    """Prefilling a new request into a free lane must not disturb a lane
+    mid-conversation: decode lane 0, prefill lane 1, keep decoding lane 0
+    — the token stream must equal an undisturbed run."""
+    mp, _ = tiny_model
+    prompt = [5, 6, 7, 8, 9]
+    e1 = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    expected, _, _ = e1.generate(prompt, max_steps=20)
+
+    eb = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                         batch_size=2)
+    eb.prefill_lane(0, prompt)
+    pos = [len(prompt) - 1, 0]
+    toks = [prompt[-1], 0]
+    got = []
+    rows = eb.decode_lanes(toks, pos, 6, active=[True, False])
+    got += [r[0] for r in rows]
+    pos[0] += len(rows)
+    toks[0] = got[-1]
+    # admit a second request mid-stream, then continue lane 0
+    eb.prefill_lane(1, [30, 31, 32, 33, 34, 35, 36, 37, 38])
+    pos[1], toks[1] = 8, 38
+    while pos[0] < 20:
+        rows = eb.decode_lanes(toks, pos, 4, active=[True, True])
+        if not rows:
+            break
+        got += [r[0] for r in rows][: 20 - pos[0]]
+        pos = [pos[0] + len(rows), pos[1] + len(rows)]
+        toks = [rows[-1][0], rows[-1][1]]
+    assert got == expected, (got, expected)
+
+
 def test_perplexity_chunk_size_invariant(tiny_model):
     """Chunked on-device scoring must be invariant to the prefill bucket
     shape (the chunks see earlier chunks only through the KV cache), and
