@@ -163,10 +163,22 @@ def _attention_sp(
 
     if t == 1:
         q_spec = P("dp", None, "tp", None)
+        # Pallas local step on TPU: per-shard cache reads bounded by pos
+        # via the clamped DMA schedule (shards in the query's future pay
+        # one skipped-compute block); dense jnp stats elsewhere
+        from ..ops.flash_attention import flash_decode_stats
+
+        use_decode_flash = (
+            jax.default_backend() == "tpu"
+            and pick_decode_block(shard) is not None
+        )
 
         def body(qq, kk, vv, pp):
             idx = lax.axis_index("sp")
-            acc, m, l = attention_stats(qq, kk, vv, pp, idx * shard)
+            if use_decode_flash:
+                acc, m, l = flash_decode_stats(qq, kk, vv, pp, idx * shard)
+            else:
+                acc, m, l = attention_stats(qq, kk, vv, pp, idx * shard)
             m_g = lax.pmax(m, "sp")
             scale = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - m_g))
             l_g = lax.psum(l * scale, "sp")

@@ -229,18 +229,17 @@ def flash_attention_stats(
 
 def _flash_decode_kernel(
     pos_ref,  # SMEM scalar prefetch: [B] int32 (per-lane query positions)
+    spos_ref,  # SMEM scalar prefetch: [1] int32 (this KV shard's first pos)
     q_ref,  # [1, G, hd] (the G query heads sharing this KV head)
     k_ref,  # [1, bs, 1, hd] — a native-layout cache tile (no pre-transpose)
     v_ref,  # [1, bs, 1, hd]
-    o_ref,  # [1, G, hd]
-    m_ref,  # VMEM [G, 128]
-    l_ref,  # VMEM [G, 128]
-    acc_ref,  # VMEM [G, hd]
-    *,
+    *rest,  # emit_stats: (acc_out [1,G,hd], m_out [1,G,128], l_out [1,G,128])
+    #         else: (o_ref [1,G,hd]); then scratch (m_ref, l_ref, acc_ref)
     block_s: int,
     n_s: int,
     n_kv_heads: int,
     scale: float,
+    emit_stats: bool,
 ):
     """T=1 decode step: one query token per lane group, online softmax over
     S blocks. Blocks entirely beyond `pos` are compute-skipped here AND
@@ -250,9 +249,20 @@ def _flash_decode_kernel(
     decode attention (src/nn/nn-cpu-ops.cpp:753-788) — while the compiled
     program covers the whole cache (no per-window recompiles). Positions
     are per LANE (pos_ref[b]), so independent decode lanes at different
-    depths each read only their own ~pos rows."""
+    depths each read only their own ~pos rows. With `emit_stats` the
+    kernel emits the UNNORMALIZED (acc, m, l) partial state relative to a
+    KV shard starting at absolute position spos_ref[0] — the sp-sharded
+    decode's local step (models/transformer._attention_sp merges these
+    across shards)."""
+    if emit_stats:
+        acc_out, m_out, l_out, m_ref, l_ref, acc_ref = rest
+    else:
+        (o_ref, m_ref, l_ref, acc_ref) = rest
     si = pl.program_id(1)
     pos = pos_ref[pl.program_id(0) // n_kv_heads]
+    # highest LOCAL row index this query may see (negative: whole shard
+    # is in the future -> nothing computes, stats emit as fully-masked)
+    local_limit = pos - spos_ref[0]
 
     @pl.when(si == 0)
     def _init():
@@ -262,7 +272,7 @@ def _flash_decode_kernel(
 
     s_start = si * block_s
 
-    @pl.when(s_start <= pos)
+    @pl.when(s_start <= local_limit)
     def _compute():
         g = q_ref.shape[1]
         q = q_ref[0].astype(jnp.float32)  # [G, hd]
@@ -274,10 +284,10 @@ def _flash_decode_kernel(
             )
             * scale
         )  # [G, bs]
-        s_pos = s_start + jax.lax.broadcasted_iota(
+        s_row = s_start + jax.lax.broadcasted_iota(
             jnp.int32, (g, block_s), 1
         )
-        scores = jnp.where(s_pos <= pos, scores, _NEG_INF)
+        scores = jnp.where(s_row <= local_limit, scores, _NEG_INF)
         m_prev = m_ref[:, :1]
         m_cur = jnp.max(scores, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -296,11 +306,16 @@ def _flash_decode_kernel(
 
     @pl.when(si == n_s - 1)
     def _emit():
-        # pos indexes a row written this step (the engine appends k/v at
-        # pos before attention), so l >= 1 always; the guard is belt and
-        # braces for direct op-level callers
-        l_safe = jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
-        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        if emit_stats:
+            acc_out[0] = acc_ref[:]
+            m_out[0] = m_ref[:]
+            l_out[0] = l_ref[:]
+        else:
+            # pos indexes a row written this step (the engine appends k/v
+            # at pos before attention), so l >= 1 always; the guard is
+            # belt and braces for direct op-level callers
+            l_safe = jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
+            o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
 
 
 def pick_decode_block(s: int) -> int | None:
@@ -312,16 +327,23 @@ def pick_decode_block(s: int) -> int | None:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("block_s", "interpret"))
-def flash_decode(
+@functools.partial(
+    jax.jit, static_argnames=("block_s", "interpret", "emit_stats")
+)
+def _flash_decode_impl(
     q: jnp.ndarray,  # [B, 1, H, hd]
     k_cache: jnp.ndarray,  # [B, S, KH, hd]
     v_cache: jnp.ndarray,  # [B, S, KH, hd]
     pos: jnp.ndarray,  # scalar int32, or [B] per-lane positions
+    s_pos0: jnp.ndarray,  # scalar int32: absolute position of cache row 0
     block_s: int = 0,
     interpret: bool = False,
-) -> jnp.ndarray:
-    """Single-token causal GQA attention; returns [B, 1, H, hd] in q.dtype.
+    emit_stats: bool = False,
+):
+    """Single-token causal GQA attention over a (possibly shard-local) KV
+    range. Normalized output [B, 1, H, hd] (emit_stats=False) or the
+    unnormalized (acc, m, l) partial state in attention_stats layout
+    (emit_stats=True, the sp decode local step).
 
     The G = H/KH query heads of each KV group ride the sublane dim (one
     [G, hd] x [hd, block_s] matmul per KV block), and the kv BlockSpec
@@ -353,48 +375,110 @@ def flash_decode(
     pos_arr = jnp.broadcast_to(
         jnp.atleast_1d(jnp.asarray(pos, jnp.int32)), (b,)
     )
+    spos_arr = jnp.asarray(s_pos0, jnp.int32).reshape(1)
 
-    def q_map(bk, si, pos_ref):
+    def q_map(bk, si, pos_ref, spos_ref):
         return (bk, 0, 0)
 
-    def kv_map(bk, si, pos_ref):
+    def kv_map(bk, si, pos_ref, spos_ref):
         # clamp: revisiting the same block index elides the DMA, so blocks
         # beyond this lane's pos cost no HBM traffic
-        return (
-            bk // kh,
-            jnp.minimum(si, pos_ref[bk // kh] // block_s),
-            bk % kh,
-            0,
-        )
+        limit = jnp.maximum(pos_ref[bk // kh] - spos_ref[0], 0)
+        return (bk // kh, jnp.minimum(si, limit // block_s), bk % kh, 0)
 
-    out = pl.pallas_call(
-        functools.partial(
-            _flash_decode_kernel,
-            block_s=block_s,
-            n_s=n_s,
-            n_kv_heads=kh,
-            scale=scale,
-        ),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(b * kh, n_s),
-            in_specs=[
+    kernel = functools.partial(
+        _flash_decode_kernel,
+        block_s=block_s,
+        n_s=n_s,
+        n_kv_heads=kh,
+        scale=scale,
+        emit_stats=emit_stats,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * kh, n_s),
+        in_specs=[
+            pl.BlockSpec((1, g, hd), q_map),
+            pl.BlockSpec((1, block_s, 1, hd), kv_map),
+            pl.BlockSpec((1, block_s, 1, hd), kv_map),
+        ],
+        out_specs=(
+            [
                 pl.BlockSpec((1, g, hd), q_map),
-                pl.BlockSpec((1, block_s, 1, hd), kv_map),
-                pl.BlockSpec((1, block_s, 1, hd), kv_map),
-            ],
-            out_specs=pl.BlockSpec((1, g, hd), q_map),
-            scratch_shapes=[
-                pltpu.VMEM((g, 128), jnp.float32),
-                pltpu.VMEM((g, 128), jnp.float32),
-                pltpu.VMEM((g, hd), jnp.float32),
-            ],
+                pl.BlockSpec((1, g, 128), q_map),
+                pl.BlockSpec((1, g, 128), q_map),
+            ]
+            if emit_stats
+            else pl.BlockSpec((1, g, hd), q_map)
         ),
-        out_shape=jax.ShapeDtypeStruct((b * kh, g, hd), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((g, 128), jnp.float32),
+            pltpu.VMEM((g, 128), jnp.float32),
+            pltpu.VMEM((g, hd), jnp.float32),
+        ],
+    )
+    out_shape = (
+        [
+            jax.ShapeDtypeStruct((b * kh, g, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b * kh, g, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b * kh, g, 128), jnp.float32),
+        ]
+        if emit_stats
+        else jax.ShapeDtypeStruct((b * kh, g, hd), jnp.float32)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
         interpret=interpret,
-    )(pos_arr, qt, k_cache, v_cache)
+    )(pos_arr, spos_arr, qt, k_cache, v_cache)
 
+    if emit_stats:
+        acc, m, l = out
+        # match ops/jnp_ops.attention_stats: acc [B, KH, G, T=1, hd],
+        # m/l [B, KH, G, 1]
+        acc = acc.reshape(b, kh, g, 1, hd)
+        m = m[:, :, 0].reshape(b, kh, g, 1)
+        l = l[:, :, 0].reshape(b, kh, g, 1)
+        return acc, m, l
     return out.reshape(b, kh, g, hd).reshape(b, 1, h, hd).astype(q.dtype)
+
+
+def flash_decode(
+    q: jnp.ndarray,  # [B, 1, H, hd]
+    k_cache: jnp.ndarray,  # [B, S, KH, hd]
+    v_cache: jnp.ndarray,
+    pos: jnp.ndarray,  # scalar int32, or [B] per-lane positions
+    block_s: int = 0,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Normalized single-token decode attention (see _flash_decode_impl)."""
+    return _flash_decode_impl(
+        q, k_cache, v_cache, pos, jnp.int32(0),
+        block_s=block_s, interpret=interpret, emit_stats=False,
+    )
+
+
+def flash_decode_stats(
+    q: jnp.ndarray,  # [B, 1, H, hd]
+    k_cache: jnp.ndarray,  # [B, Ss, KH, hd] — one sequence SHARD
+    v_cache: jnp.ndarray,
+    pos: jnp.ndarray,  # scalar or [B]
+    s_pos0: jnp.ndarray,  # absolute position of this shard's row 0
+    block_s: int = 0,
+    interpret: bool = False,
+):
+    """Unnormalized (acc, m, l) decode partial state over a KV shard —
+    the Pallas local step for sp-sharded decode (the shard_map body in
+    models/transformer._attention_sp merges these with a log-sum-exp
+    pmax/psum). Shards entirely in the query's future emit fully-masked
+    stats (m = -inf, l = 0); their DMA floor is ONE block per KV head
+    (the clamp pins the index at block 0, whose copy still happens —
+    compute is skipped), everything beyond that is elided."""
+    return _flash_decode_impl(
+        q, k_cache, v_cache, pos, jnp.asarray(s_pos0, jnp.int32),
+        block_s=block_s, interpret=interpret, emit_stats=True,
+    )
 
 
 def flash_attention(

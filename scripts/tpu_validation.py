@@ -95,6 +95,34 @@ def main() -> None:
         err = float(jnp.abs(fo.astype(jnp.float32) - fr.astype(jnp.float32)).max())
         record(f"flash decode abs err pos={p}", f"{err:.2e} {'OK' if err < 2e-2 else 'FAIL'}")
 
+    # 2c. flash decode STATS variant (the sp-decode local step) on silicon:
+    # Mosaic lowering of the stats out-specs + the shard-offset clamp only
+    # ever runs here before an sp>1 deployment would hit it
+    from dllama_tpu.ops.flash_attention import flash_decode_stats
+    from dllama_tpu.ops.jnp_ops import attention_stats as jnp_stats
+
+    Ss = S // 2
+    for p, s0 in ((Ss // 2, 0), (Ss // 2, Ss), (S - 1, Ss)):
+        acc, m, l = flash_decode_stats(
+            qd, kd[:, :Ss], vd[:, :Ss], jnp.int32(p), jnp.int32(s0)
+        )
+        acc_r, m_r, l_r = jnp_stats(
+            qd, kd[:, :Ss], vd[:, :Ss], jnp.int32(p), jnp.int32(s0)
+        )
+        lmask = np.asarray(l_r) > 0
+        if lmask.any():
+            o = np.asarray(acc) / np.maximum(np.asarray(l)[..., None], 1e-30)
+            o_r = np.asarray(acc_r) / np.maximum(
+                np.asarray(l_r)[..., None], 1e-30
+            )
+            err = float(np.abs(o[lmask] - o_r[lmask]).max())
+        else:
+            err = float(np.abs(np.asarray(l)).max())  # must be all-masked too
+        record(
+            f"flash decode stats err pos={p} s0={s0}",
+            f"{err:.2e} {'OK' if err < 2e-2 else 'FAIL'}",
+        )
+
     t_low = timeit(lambda: flash_decode(qd, kd, vd, jnp.int32(512)))
     t_high = timeit(lambda: flash_decode(qd, kd, vd, jnp.int32(S - 1)))
     # clamped DMA schedule => decode at pos=512 must be much cheaper than

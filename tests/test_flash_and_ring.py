@@ -61,6 +61,51 @@ def test_flash_decode_gqa_groupings(h, kh):
         )
 
 
+def test_flash_decode_stats_matches_jnp_stats():
+    """The decode-stats variant (sp decode local step) vs the shared jnp
+    partial-state math, across shard offsets — including a shard entirely
+    in the query's future (fully-masked stats) and per-lane positions."""
+    from dllama_tpu.ops.flash_attention import flash_decode_stats
+    from dllama_tpu.ops.jnp_ops import attention_stats
+
+    q, k, v = make_qkv(2, 1, 4, 2, 16, 32, seed=14)
+    for pos, s0 in [(20, 0), (20, 16), (10, 16), (3, 0), (31, 16)]:
+        acc, m, l = flash_decode_stats(
+            q, k, v, jnp.int32(pos), jnp.int32(s0), block_s=8, interpret=True
+        )
+        acc_r, m_r, l_r = attention_stats(q, k, v, jnp.int32(pos), jnp.int32(s0))
+        mask = np.asarray(l_r) > 0
+        assert (np.asarray(l) > 0).tolist() == mask.tolist(), (pos, s0)
+        if mask.any():
+            o = np.asarray(acc) / np.maximum(np.asarray(l)[..., None], 1e-30)
+            o_r = np.asarray(acc_r) / np.maximum(
+                np.asarray(l_r)[..., None], 1e-30
+            )
+            np.testing.assert_allclose(
+                o[mask], o_r[mask], rtol=1e-5, atol=1e-5, err_msg=f"{pos},{s0}"
+            )
+            lse = np.asarray(m) + np.log(np.maximum(np.asarray(l), 1e-30))
+            lse_r = np.asarray(m_r) + np.log(
+                np.maximum(np.asarray(l_r), 1e-30)
+            )
+            np.testing.assert_allclose(
+                lse[mask], lse_r[mask], rtol=1e-5, atol=1e-5
+            )
+    # per-lane positions: lane 0 deep, lane 1 shallow
+    posv = jnp.asarray([24, 5], jnp.int32)
+    acc, m, l = flash_decode_stats(
+        q, k, v, posv, jnp.int32(0), block_s=8, interpret=True
+    )
+    for lane, p in enumerate([24, 5]):
+        acc_r, m_r, l_r = attention_stats(
+            q[lane : lane + 1], k[lane : lane + 1], v[lane : lane + 1],
+            jnp.int32(p), jnp.int32(0),
+        )
+        o = np.asarray(acc[lane]) / np.asarray(l[lane])[..., None]
+        o_r = np.asarray(acc_r[0]) / np.asarray(l_r[0])[..., None]
+        np.testing.assert_allclose(o, o_r, rtol=1e-5, atol=1e-5)
+
+
 def test_flash_decode_bf16():
     from dllama_tpu.ops.flash_attention import flash_decode
 
