@@ -65,27 +65,38 @@ class NaiveCache:
     def clear(self) -> None:
         self.items = []
 
+    def _matches_all(self, messages: list[ChatMessage]) -> bool:
+        """True when `messages` strictly extends the cached conversation
+        (single source of the match rule for probe and resolve)."""
+        n = len(self.items)
+        if n == 0 or len(messages) <= n:
+            return False
+        return all(
+            self.items[i].message.role == messages[i].role
+            and self.items[i].message.content == messages[i].content
+            for i in range(n)
+        )
+
+    def probe(self, messages: list[ChatMessage]) -> int:
+        """Start position a resolve would reuse, WITHOUT mutating — the
+        lane scheduler peeks at every free lane's cache to route a
+        continuing conversation back to its lane."""
+        if self._matches_all(messages):
+            return self.items[-1].end_pos
+        return 0
+
     def resolve_delta_prompt(
         self, messages: list[ChatMessage]
     ) -> tuple[list[ChatMessage], int]:
         """If `messages` extends the cached conversation, return only the new
         suffix plus the cache's end position; else reset."""
-        n = len(self.items)
-        if n == 0:
+        if not self.items:
             return messages, 0
-        if len(messages) > n:
-            i = 0
-            while i < n:
-                if (
-                    self.items[i].message.role != messages[i].role
-                    or self.items[i].message.content != messages[i].content
-                ):
-                    break
-                i += 1
-            if i == n:
-                start_pos = self.items[i - 1].end_pos
-                print(f"🐤 Found naive cache for {i} messages, pos={start_pos}")
-                return messages[i:], start_pos
+        if self._matches_all(messages):
+            n = len(self.items)
+            start_pos = self.items[-1].end_pos
+            print(f"🐤 Found naive cache for {n} messages, pos={start_pos}")
+            return messages[n:], start_pos
         self.clear()
         return messages, 0
 
@@ -127,6 +138,9 @@ class _LaneState:
     decoder: object  # tokenizer StreamDecoder
     temperature: float
     top_p: float
+    # conversation bookkeeping for this lane's NaiveCache push on finish
+    delta_messages: list = field(default_factory=list)
+    prompt_end: int = 0
 
 
 class LaneScheduler:
@@ -140,10 +154,13 @@ class LaneScheduler:
     accept loop (src/dllama-api.cpp:563-574) lacks entirely: N clients
     stream simultaneously at roughly the single-stream decode rate.
 
-    The NaiveCache prompt-prefix reuse is intentionally not used here —
-    lanes are recycled across unrelated clients, so every request
-    prefills from position 0 in its lane (the batch_size == 1 path keeps
-    the cache behavior).
+    Each lane keeps its own NaiveCache: a continuing conversation is
+    routed back to the (free) lane still holding its KV prefix and only
+    the delta is prefilled — per-lane prompt-prefix reuse under
+    concurrency (the reference's NaiveCache serves its single stream,
+    src/dllama-api.cpp:298-343). The last generated token is carried as
+    a "pending" token and fed at resume, so the resumed context contains
+    exactly the tokens the conversation produced.
     """
 
     def __init__(self, state: "ApiState", block_size: int = 8):
@@ -151,6 +168,14 @@ class LaneScheduler:
         self.engine = state.engine
         self.block_size = block_size
         self.lanes: list[_LaneState | None] = [None] * self.engine.batch_size
+        self.lane_cache = [NaiveCache() for _ in range(self.engine.batch_size)]
+        # each lane's final generated token (its KV row is unwritten; it
+        # is fed at the cache's recorded end position on resume)
+        self.lane_pending: list[int | None] = [None] * self.engine.batch_size
+        # admission counter per lane: evict the least-recently-used cache
+        # when a fresh conversation needs a lane
+        self.lane_used: list[int] = [0] * self.engine.batch_size
+        self._admission_count = 0
         self.pending: list[LaneJob] = []
         self.cv = threading.Condition()
         self.thread = threading.Thread(target=self._loop, daemon=True)
@@ -171,11 +196,26 @@ class LaneScheduler:
                 while not self.pending and not any(self.lanes):
                     self.cv.wait()
                 admissions = []
-                for lane in range(len(self.lanes)):
-                    if not self.pending:
-                        break
-                    if self.lanes[lane] is None:
-                        admissions.append((lane, self.pending.pop(0)))
+                free = [i for i in range(len(self.lanes)) if self.lanes[i] is None]
+                while self.pending and free:
+                    job = self.pending.pop(0)
+                    # conversation affinity: prefer the free lane whose
+                    # cache already holds this conversation's prefix; for
+                    # fresh conversations prefer an EMPTY lane, then the
+                    # least-recently-used one, so a live conversation's
+                    # reusable cache isn't the first thing evicted
+                    lane = max(
+                        free,
+                        key=lambda i: (
+                            self.lane_cache[i].probe(job.params.messages),
+                            not self.lane_cache[i].items,
+                            -self.lane_used[i],
+                        ),
+                    )
+                    free.remove(lane)
+                    self._admission_count += 1
+                    self.lane_used[lane] = self._admission_count
+                    admissions.append((lane, job))
             for lane, job in admissions:
                 self._admit(lane, job)
             if any(self.lanes):
@@ -185,28 +225,49 @@ class LaneScheduler:
                     # the scheduler thread must survive any engine error:
                     # fail every in-flight request loudly and keep serving
                     # (the reference's crash-retry loop plays this role
-                    # for its single stream, dllama-api.cpp:616-628)
+                    # for its single stream, dllama-api.cpp:616-628). The
+                    # failed dispatch donated the KV cache buffer, so NO
+                    # lane's cached conversation can be trusted afterwards
+                    # — drop them all rather than resume on corrupt KV.
                     for lane in range(len(self.lanes)):
                         if self.lanes[lane] is not None:
                             self.lanes[lane].job.events.put(("error", str(e)))
                             self.lanes[lane] = None
+                        self.lane_cache[lane].clear()
+                        self.lane_pending[lane] = None
                     with self.cv:
                         self.cv.notify_all()
 
     def _admit(self, lane: int, job: LaneJob) -> None:
         state, engine, tok = self.state, self.engine, self.state.tokenizer
         p = job.params
+        engine_touched = False
         try:
-            items = [ChatItem(m.role, m.content) for m in p.messages]
+            cache = self.lane_cache[lane]
+            delta_prompt, start_pos = cache.resolve_delta_prompt(p.messages)
+            pending = self.lane_pending[lane] if start_pos > 0 else None
+            if start_pos == 0:
+                self.lane_pending[lane] = None
+            items = [ChatItem(m.role, m.content) for m in delta_prompt]
             prompt = state.template.generate(items, append_generation_prompt=True)
             tokens = tok.encode(
-                prompt.content, is_start=True, add_special_tokens=True
+                prompt.content,
+                is_start=start_pos == 0,
+                add_special_tokens=True,
             )
+            if pending is not None:
+                # feed the conversation's final generated token first (its
+                # KV row was never written — the single-stream path runs a
+                # KV-only decode_step for this, complete() above); it
+                # belongs at the cache's recorded end position, start_pos
+                tokens = [pending] + tokens
+            pos0 = start_pos
             seq_len = engine.header.seq_len
-            prompt_end = len(tokens) - 1
+            prompt_end = pos0 + len(tokens) - 1
             if prompt_end >= seq_len:
                 raise ValueError(
-                    f"prompt of {len(tokens)} tokens exceeds seqLen {seq_len}"
+                    f"prompt of {len(tokens)} tokens at pos {pos0} exceeds "
+                    f"seqLen {seq_len}"
                 )
             max_pos = (
                 min(prompt_end + p.max_tokens, seq_len)
@@ -219,7 +280,8 @@ class LaneScheduler:
             # seeded request still wouldn't be reproducible — its draws
             # depend on which other lanes are active). batch_size == 1
             # keeps full seed semantics.
-            engine.prefill_lane(lane, tokens)
+            engine_touched = True
+            engine.prefill_lane(lane, tokens, pos0=pos0)
             if prompt.public_prompt:
                 job.buffer += prompt.public_prompt
                 job.events.put(("delta", prompt.public_prompt))
@@ -239,13 +301,38 @@ class LaneScheduler:
                 decoder=tok.stream_decoder(),
                 temperature=p.temperature,
                 top_p=p.top_p,
+                delta_messages=list(delta_prompt),
+                prompt_end=prompt_end,
             )
         except Exception as e:
             job.events.put(("error", str(e)))
             self.lanes[lane] = None
+            if engine_touched:
+                # the prefill may have partially written this lane's cache
+                if self.lane_cache[lane].items:
+                    self.lane_cache[lane].clear()
+                self.lane_pending[lane] = None
+            # validation errors before any engine call leave the lane's
+            # cached conversation intact and reusable
 
     def _finish(self, lane: int, reason: str) -> None:
         ls = self.lanes[lane]
+        cache = self.lane_cache[lane]
+        if reason in ("stop", "length") and ls.pos < self.engine.header.seq_len:
+            # record the conversation for prefix reuse: delta messages at
+            # the prompt end, the assistant turn at the current position;
+            # the final token is carried as pending and fed on resume
+            for m in ls.delta_messages:
+                cache.push(NaiveCacheItem(ls.prompt_end, m))
+            cache.push(
+                NaiveCacheItem(ls.pos, ChatMessage("assistant", ls.job.buffer))
+            )
+            self.lane_pending[lane] = ls.token
+        else:
+            # cancelled / errored / out of cache: this lane's KV no longer
+            # matches a recordable conversation
+            cache.clear()
+            self.lane_pending[lane] = None
         ls.job.events.put(("done", reason))
         self.lanes[lane] = None
         with self.cv:

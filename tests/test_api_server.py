@@ -276,6 +276,42 @@ def test_lane_server_streaming(lane_server):
     assert '"finish_reason"' in body
 
 
+def test_lane_server_conversation_affinity(lane_server):
+    """A continuing conversation is routed back to its lane and resumes
+    from the cached prefix (per-lane NaiveCache): turn 2 must produce a
+    normal completion, and a concurrent unrelated request must not
+    disturb it."""
+    def ask(messages):
+        with _post(lane_server, {
+            "messages": messages, "max_tokens": 8, "temperature": 0,
+        }) as r:
+            body = json.loads(r.read())
+        return (body["choices"][0]["message"]["content"],
+                body["usage"]["prompt_tokens"])
+
+    convo = [{"role": "user", "content": "tell me a story"}]
+    a1, _ = ask(convo)
+    # interleave an unrelated request (occupies some lane)
+    ask([{"role": "user", "content": "unrelated"}])
+    convo += [{"role": "assistant", "content": a1},
+              {"role": "user", "content": "continue"}]
+    a2, n2 = ask(convo)
+    # same-shape conversation with a different opening -> no cache match,
+    # full render; the matched continuation must have prefilled fewer
+    # tokens (just the delta + pending token)
+    fresh = [dict(convo[0], content="a different opening line"),
+             convo[1], convo[2]]
+    _, n_full = ask(fresh)
+    assert n2 < n_full, (n2, n_full)
+    # the conversation keeps extending through its lane cache: the third
+    # turn's delta must be smaller than the second turn's full-render
+    # equivalent even though the conversation got longer
+    convo += [{"role": "assistant", "content": a2},
+              {"role": "user", "content": "more"}]
+    a3, n3 = ask(convo)
+    assert isinstance(a3, str) and n3 < n_full, (n3, n_full)
+
+
 def test_api_main_chat_template_flag(tmp_path):
     """--chat-template forces the template type even when the tokenizer
     carries a different/absent jinja template."""
