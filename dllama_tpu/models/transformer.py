@@ -98,13 +98,8 @@ def _attention_tp(
     s = k_cache.shape[1]
     if on_tpu and t == 1 and pick_decode_block(s) is not None:
         kernel = flash_decode  # handles scalar and per-lane pos
-    elif (
-        on_tpu
-        and not per_lane
-        and t >= 8
-        and pick_flash_blocks(t, s) is not None
-    ):
-        kernel = flash_attention
+    elif on_tpu and t >= 8 and pick_flash_blocks(t, s) is not None:
+        kernel = flash_attention  # handles scalar and per-lane pos
     else:
         return _attention(q, k_cache, v_cache, pos, head_dim)
     n_heads = q.shape[2]
@@ -433,11 +428,12 @@ def forward(
     params: Params,
     h: LlmHeader,
     tokens: jnp.ndarray,  # [B, T] int32
-    pos: jnp.ndarray,  # scalar int32
+    pos: jnp.ndarray,  # scalar int32, or [B] per-lane positions
     cache: KvCache,
     mesh=None,
     moe_gather_max_tokens: int = 0,
     attn_window: int = 0,
+    attn_park_threshold: int = 0,
 ) -> Tuple[jnp.ndarray, KvCache]:
     """Run the decoder on T tokens starting at absolute position `pos`.
 
@@ -453,6 +449,13 @@ def forward(
     `attn_window` cache rows — the caller guarantees pos + T <= window.
     On a 128k-seq-len model decoding at position 1k this cuts per-step
     cache reads by 128x; cache writes still land in the full-length cache.
+
+    `attn_park_threshold` (static, per-lane mode): lanes whose position is
+    >= the threshold are PARKED — their cache writes land at that position
+    (the engine's padding rows) but their attention queries are masked out
+    entirely (position pushed strongly negative), so an idle or prefilling
+    -elsewhere lane costs one skipped-compute block instead of a full
+    cache scan, and its discarded output is exactly zero.
     """
     b, t = tokens.shape
     interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
@@ -462,6 +465,15 @@ def forward(
     # position (independent request lanes — the continuous-batching
     # surface the reference's single-stream loop lacks)
     per_lane = jnp.ndim(pos) == 1
+    if per_lane and attn_park_threshold:
+        # parked lanes: writes at `pos`, attention masked out (see above).
+        # The sentinel must stay negative for every query row of a T-wide
+        # chunk, hence -(cache length).
+        attn_pos = jnp.where(
+            pos >= attn_park_threshold, -cache["k"].shape[2], pos
+        )
+    else:
+        attn_pos = pos
 
     x = params["embed"][tokens]  # [B, T, D] (reference: OP_EMBEDDING)
 
@@ -506,7 +518,7 @@ def forward(
             v_view = v_cache_l[:, :attn_window]
         else:
             k_view, v_view = k_cache_l, v_cache_l
-        z = _attention_tp(q, k_view, v_view, pos, h.head_dim, mesh)
+        z = _attention_tp(q, k_view, v_view, attn_pos, h.head_dim, mesh)
         x = x + _mm(z, lp["wo"], "col", mesh).astype(x.dtype)
 
         # -- FFN block (reference: src/llm.cpp:405-557) --

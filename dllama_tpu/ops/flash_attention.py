@@ -58,7 +58,8 @@ def attention_ref(
 
 
 def _flash_stats_kernel(
-    pos_ref,  # SMEM scalar prefetch: [2] int32 (q_pos0, s_pos0)
+    pos_ref,  # SMEM scalar prefetch: [B] int32 per-lane q start positions
+    spos_ref,  # SMEM scalar prefetch: [1] int32 (s_pos0)
     q_ref,  # [1, bt, hd]
     k_ref,  # [1, bs, 1, hd] — native-layout cache tile (no pre-transpose)
     v_ref,  # [1, bs, 1, hd]
@@ -72,15 +73,20 @@ def _flash_stats_kernel(
     block_t: int,
     block_s: int,
     n_s: int,
+    n_heads: int,
     scale: float,
 ):
     """Like _flash_kernel but emits UNNORMALIZED online-softmax partial
     state (acc, m, l) — the drop-in local step for ring attention's
-    log-sum-exp merge (parallel/ring_attention.py)."""
+    log-sum-exp merge (parallel/ring_attention.py). Query positions are
+    per LANE (pos_ref[b]); a lane position <= -T keeps EVERY query row of
+    the chunk negative (the engine's parked lanes use -(cache length)),
+    producing fully-masked stats at one block of DMA. A bare -1 would
+    only mask the first row of a multi-row chunk."""
     ti = pl.program_id(1)
     si = pl.program_id(2)
-    q_pos0 = pos_ref[0] + ti * block_t
-    s_pos0 = pos_ref[1]
+    q_pos0 = pos_ref[pl.program_id(0) // n_heads] + ti * block_t
+    s_pos0 = spos_ref[0]
 
     @pl.when(si == 0)
     def _init():
@@ -135,7 +141,7 @@ def flash_attention_stats(
     q: jnp.ndarray,  # [B, T, H, hd]
     k: jnp.ndarray,  # [B, S, KH, hd]
     v: jnp.ndarray,  # [B, S, KH, hd]
-    q_pos0: jnp.ndarray,  # scalar int32: absolute position of q[:, 0]
+    q_pos0: jnp.ndarray,  # scalar or [B] int32: position of q[:, 0] per lane
     s_pos0: jnp.ndarray,  # scalar int32: absolute position of k[:, 0]
     block_t: int = 0,
     block_s: int = 0,
@@ -143,7 +149,9 @@ def flash_attention_stats(
 ):
     """Blockwise causal GQA attention partial state: returns f32
     (acc [B, KH, G, T, hd], m [B, KH, G, T], l [B, KH, G, T]) — the same
-    contract as ops/jnp_ops.attention_stats, MXU-tiled."""
+    contract as ops/jnp_ops.attention_stats, MXU-tiled. A vector q_pos0
+    gives each lane its own query start (per-lane prefill); a strongly
+    negative lane position masks that lane entirely at one block of DMA."""
     b, t, h, hd = q.shape
     s, kh = k.shape[1], k.shape[2]
     g = h // kh
@@ -169,19 +177,22 @@ def flash_attention_stats(
     # native [B, S, KH, hd] layout — a pre-transpose would copy all S rows
     # per call
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
-    pos_arr = jnp.stack(
-        [jnp.asarray(q_pos0, jnp.int32), jnp.asarray(s_pos0, jnp.int32)]
+    pos_arr = jnp.broadcast_to(
+        jnp.atleast_1d(jnp.asarray(q_pos0, jnp.int32)), (b,)
     )
+    spos_arr = jnp.asarray(s_pos0, jnp.int32).reshape(1)
 
-    def q_map(bh, ti, si, pos_ref):
+    def q_map(bh, ti, si, pos_ref, spos_ref):
         return (bh, ti, 0)
 
-    def kv_map(bh, ti, si, pos_ref):
+    def kv_map(bh, ti, si, pos_ref, spos_ref):
         # clamp past the causal frontier of this query tile: revisiting a
         # block index elides the DMA, so fully-masked tiles (and cache rows
         # beyond pos in chunked prefill) cost no HBM traffic
         limit = jnp.maximum(
-            (pos_ref[0] + (ti + 1) * block_t - 1 - pos_ref[1]) // block_s, 0
+            (pos_ref[bh // h] + (ti + 1) * block_t - 1 - spos_ref[0])
+            // block_s,
+            0,
         )
         return (bh // h, jnp.minimum(si, limit), (bh % h) // g, 0)
 
@@ -191,10 +202,11 @@ def flash_attention_stats(
             block_t=block_t,
             block_s=block_s,
             n_s=n_s,
+            n_heads=h,
             scale=scale,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(b * h, n_t, n_s),
             in_specs=[
                 pl.BlockSpec((1, block_t, hd), q_map),
@@ -218,7 +230,7 @@ def flash_attention_stats(
             jax.ShapeDtypeStruct((b * h, t, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(pos_arr, qt, k, v)
+    )(pos_arr, spos_arr, qt, k, v)
 
     # [B*H, T, ...] -> [B, KH, G, T, ...]
     acc = acc.reshape(b, kh, g, t, hd)
@@ -485,7 +497,7 @@ def flash_attention(
     q: jnp.ndarray,  # [B, T, H, hd]
     k_cache: jnp.ndarray,  # [B, S, KH, hd]
     v_cache: jnp.ndarray,  # [B, S, KH, hd]
-    pos: jnp.ndarray,  # scalar int32
+    pos: jnp.ndarray,  # scalar int32, or [B] per-lane positions
     block_t: int = 0,
     block_s: int = 0,
     interpret: bool = False,

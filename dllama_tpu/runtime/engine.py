@@ -517,6 +517,8 @@ class InferenceEngine:
         mesh = self.mesh
         precision = self._precision
 
+        park = self._park
+
         @partial(jax.jit, donate_argnums=(2,))
         def step(params, tokens, cache, pos_vec):
             ctx = (
@@ -527,7 +529,7 @@ class InferenceEngine:
             with ctx:
                 _, cache = forward(
                     params, h, tokens, pos_vec, cache, mesh=mesh,
-                    attn_window=window,
+                    attn_window=window, attn_park_threshold=park,
                 )
             return cache
 
@@ -601,7 +603,8 @@ class InferenceEngine:
                 )
                 with ctx:
                     logits, cache = forward(
-                        params, h, tok, cur, cache, mesh=mesh
+                        params, h, tok, cur, cache, mesh=mesh,
+                        attn_park_threshold=park,
                     )
                 last = logits[:, -1, :]
                 nxt = _sample_on_device(

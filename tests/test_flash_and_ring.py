@@ -255,6 +255,37 @@ def test_flash_stats_matches_jnp_stats():
         np.testing.assert_allclose(lse[mask], lse_r[mask], rtol=1e-5, atol=1e-5)
 
 
+def test_flash_stats_per_lane_positions():
+    """Vector q_pos0 in the prefill stats kernel: each lane's chunk starts
+    at its own position; a strongly negative lane (the engine's parked
+    sentinel) yields fully-masked stats."""
+    from dllama_tpu.ops.flash_attention import flash_attention_stats
+    from dllama_tpu.ops.jnp_ops import attention_stats
+
+    q, k, v = make_qkv(3, 8, 4, 2, 16, 32, seed=15)
+    posv = jnp.asarray([0, 16, -64], jnp.int32)  # lane 2 parked
+    acc, m, l = flash_attention_stats(
+        q, k, v, posv, jnp.int32(0), block_t=8, block_s=8, interpret=True
+    )
+    for lane, p in enumerate([0, 16]):
+        acc_r, m_r, l_r = attention_stats(
+            q[lane : lane + 1], k[lane : lane + 1], v[lane : lane + 1],
+            jnp.int32(p), jnp.int32(0),
+        )
+        mask = np.asarray(l_r[0]) > 0
+        o = np.asarray(acc[lane]) / np.maximum(
+            np.asarray(l[lane])[..., None], 1e-30
+        )
+        o_r = np.asarray(acc_r[0]) / np.maximum(
+            np.asarray(l_r[0])[..., None], 1e-30
+        )
+        np.testing.assert_allclose(
+            o[mask], o_r[mask], rtol=1e-5, atol=1e-5, err_msg=f"lane {lane}"
+        )
+    # parked lane: zero weight everywhere
+    assert float(np.abs(np.asarray(l[2])).max()) == 0.0
+
+
 def test_ring_with_flash_local_step():
     """Ring attention using the Pallas flash-stats local step (interpret)
     must equal the single-device reference."""

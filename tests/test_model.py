@@ -113,6 +113,37 @@ def test_batch_axis(tmp_path):
     )
 
 
+def test_forward_parked_lane_isolation(tmp_path):
+    """Per-lane forward with a parked lane (attn_park_threshold): the
+    active lane's logits must equal a solo run, the parked lane's writes
+    must land only in the padding rows, and its masked attention output
+    must be finite."""
+    h, params, _ = build(tmp_path)
+    s = h.seq_len
+    pad = 8
+    park = s  # first padding row
+    # solo reference: one lane at pos 3
+    cache1 = init_kv_cache(h, batch_size=1, seq_len=s + pad)
+    tok = jnp.asarray([[7, 9]], dtype=jnp.int32)
+    # seed the cache with a short prefix so attention has context
+    logits1, cache1 = forward(params, h, tok, jnp.int32(3), cache1)
+
+    cache2 = init_kv_cache(h, batch_size=2, seq_len=s + pad)
+    tok2 = jnp.asarray([[7, 9], [1, 2]], dtype=jnp.int32)
+    posv = jnp.asarray([3, park], jnp.int32)
+    logits2, cache2 = forward(
+        params, h, tok2, posv, cache2, attn_park_threshold=park
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits2)[0], np.asarray(logits1)[0], rtol=1e-5, atol=1e-5
+    )
+    assert np.isfinite(np.asarray(logits2)[1]).all()
+    # parked lane wrote ONLY padding rows: its real cache region is zeros
+    k2 = np.asarray(cache2["k"])  # [L, B, S+pad, KH, hd]
+    assert np.abs(k2[:, 1, :s]).max() == 0.0
+    assert np.abs(k2[:, 1, s : s + 2]).max() > 0.0  # parked writes landed
+
+
 def test_moe_gather_decode_matches_dense_routing(tmp_path):
     """The decode-path gather MoE (active experts only) must reproduce the
     dense-routing MoE logits exactly: decode T=1 steps vs full prefill."""
