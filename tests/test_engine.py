@@ -230,6 +230,25 @@ def test_generate_batch_unequal_prompts_match_single(tiny_model):
     assert outs == singles, (outs, singles)
 
 
+def test_engine_moe_lanes_unequal_prompts(tmp_path):
+    """Qwen3-MoE through the per-lane serving surface: unequal prompts in
+    lanes reproduce single-stream outputs (per-token routing must respect
+    lane boundaries)."""
+    path = str(tmp_path / "moe.m")
+    make_tiny_model(path, arch=LlmArch.QWEN3_MOE, weight_type=FloatType.F32)
+    prompts = [[1, 2, 3, 4, 5, 6], [9, 8, 7]]
+    e1 = InferenceEngine(path, tp=1, dtype=jnp.float32, temperature=0.0)
+    singles = []
+    for p in prompts:
+        e1.reset()
+        out, _, _ = e1.generate(p, max_steps=16)
+        singles.append(out)
+    eb = InferenceEngine(path, tp=1, dtype=jnp.float32, temperature=0.0,
+                         batch_size=2)
+    outs = eb.generate_batch(prompts, max_steps=16)
+    assert outs == singles, (outs, singles)
+
+
 def test_prefill_lane_preserves_other_lanes(tiny_model):
     """Prefilling a new request into a free lane must not disturb a lane
     mid-conversation: decode lane 0, prefill lane 1, keep decoding lane 0

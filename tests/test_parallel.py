@@ -131,6 +131,26 @@ def test_engine_sp_matches_single_device(tmp_path, tp, sp):
     assert got == expected, f"tp={tp} sp={sp}: {got} != {expected}"
 
 
+def test_engine_sp_with_quantized_weights(tmp_path):
+    """sp=2 over Q40-format weights: the sequence-sharded cache and the
+    quantized matmul fallback (GSPMD off-TPU) must compose."""
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    path = str(tmp_path / "m.m")
+    # dims divisible by 32*tp (the quantized col-split shards the scale
+    # tensors' block axis)
+    cfg = dict(dim=128, hidden_dim=256, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=256, seq_len=64)
+    make_tiny_model(path, weight_type=FloatType.Q40, cfg=cfg)
+    e1 = InferenceEngine(path, tp=1, dtype=jnp.float32, temperature=0.0,
+                         weight_format="q40")
+    expected, _, _ = e1.generate([1, 2, 3, 4, 5], max_steps=16)
+    esp = InferenceEngine(path, tp=2, sp=2, dtype=jnp.float32,
+                          temperature=0.0, weight_format="q40")
+    got, _, _ = esp.generate([1, 2, 3, 4, 5], max_steps=16)
+    assert got == expected
+
+
 def test_engine_sp_rejects_bad_seq_len(tmp_path):
     from dllama_tpu.runtime.engine import InferenceEngine
 
