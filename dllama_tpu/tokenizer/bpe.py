@@ -48,8 +48,38 @@ class Tokenizer:
         self._special_ids = list(range(self.regular_vocab_size, self.vocab_size))
 
         self._decoder = codecs.getincrementaldecoder("utf-8")("replace")
+        self._native_index = None  # built lazily on first encode
+        self._native_checked = False
 
     # -- encode ---------------------------------------------------------------
+
+    def _encode_native(
+        self, raw: bytes, add_special_tokens: bool, bos_id: int
+    ) -> list[int] | None:
+        """C++ encode hot loop (native/dllama_native.cpp bpe_encode —
+        identical selection semantics, O(n log n) heap over the O(n^2)
+        rescan, vocab index built once); None when the native library
+        isn't available or the input is un-tokenizable, punting back to
+        the Python loop."""
+        if not self._native_checked:
+            self._native_checked = True
+            from ..utils import native
+
+            if native.load_library() is not None:
+                import numpy as np
+
+                blob = b"".join(self.vocab)
+                offsets = np.zeros(self.vocab_size + 1, dtype=np.int64)
+                np.cumsum([len(v) for v in self.vocab], out=offsets[1:])
+                self._native_index = native.make_bpe_index(
+                    np.frombuffer(blob, dtype=np.uint8),
+                    offsets,
+                    np.asarray(self.scores, dtype=np.float32),
+                    self.regular_vocab_size,
+                )
+        if self._native_index is None:
+            return None
+        return self._native_index.encode(raw, bos_id, add_special_tokens)
 
     def find_regular_token(self, piece: bytes) -> int:
         """Exact regular-vocab lookup (reference: src/tokenizer.cpp:206-210)."""
@@ -75,8 +105,15 @@ class Tokenizer:
             raise ValueError("input text is None")
         raw = text.encode("utf-8") if isinstance(text, str) else bytes(text)
 
+        use_bos = is_start and self.add_bos and self.bos_id >= 0
+        result = self._encode_native(
+            raw, add_special_tokens, self.bos_id if use_bos else -1
+        )
+        if result is not None:
+            return result
+
         tokens: list[int] = []
-        if is_start and self.add_bos and self.bos_id >= 0:
+        if use_bos:
             tokens.append(self.bos_id)
 
         # Greedy byte accumulation; specials matched by prefix at every byte

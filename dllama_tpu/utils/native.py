@@ -25,45 +25,78 @@ def _threads() -> int:
     return max(1, min(os.cpu_count() or 1, 16))
 
 
+_ABI_VERSION = 3
+
+
+def _needs_build() -> bool:
+    if not os.path.isfile(_LIB_PATH):
+        return True
+    try:
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        nat = os.path.abspath(_NATIVE_DIR)
+        return any(
+            os.path.getmtime(os.path.join(nat, f)) > lib_mtime
+            for f in ("dllama_native.cpp", "Makefile")
+        )
+    except OSError:
+        return False
+
+
+def _open_library():
+    lib = ctypes.CDLL(_LIB_PATH)
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    i8 = ctypes.POINTER(ctypes.c_int8)
+    f32 = ctypes.POINTER(ctypes.c_float)
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i64 = ctypes.c_int64
+    lib.q40_unpack_transposed.argtypes = [u8, i64, i64, i8, f32, ctypes.c_int]
+    lib.q40_dequant_transposed.argtypes = [u8, i64, i64, f32, ctypes.c_int]
+    lib.q40_dequant.argtypes = [u8, i64, i64, f32, ctypes.c_int]
+    lib.f32_transpose.argtypes = [f32, i64, i64, f32, ctypes.c_int]
+    lib.bpe_index_new.argtypes = [u8, i64p, f32, i64, i64]
+    lib.bpe_index_new.restype = ctypes.c_void_p
+    lib.bpe_index_free.argtypes = [ctypes.c_void_p]
+    lib.bpe_encode.argtypes = [
+        ctypes.c_void_p, u8, i64, i64, ctypes.c_int, i32, i64,
+    ]
+    lib.bpe_encode.restype = i64
+    lib.dllama_native_version.restype = ctypes.c_int
+    return lib
+
+
 def load_library(auto_build: bool = True):
-    """Load (building if needed) the native library; None when unavailable."""
+    """Load (building if needed) the native library; None when unavailable.
+    The staleness check, incremental `make`, AND the dlopen all happen
+    under one file lock — a concurrent process must not dlopen a .so that
+    another process's make is mid-way through writing."""
     global _lib, _lib_tried
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    if not os.path.isfile(_LIB_PATH) and auto_build:
-        try:
-            import fcntl
+    try:
+        import fcntl
 
-            # serialize concurrent first-use builds (pytest-xdist, multi-
-            # process launches): one builder, others wait on the lock
-            lock_path = _LIB_PATH + ".lock"
-            with open(lock_path, "w") as lock:
-                fcntl.flock(lock, fcntl.LOCK_EX)
-                if not os.path.isfile(_LIB_PATH):
+        lock_path = _LIB_PATH + ".lock"
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if auto_build and _needs_build():
+                try:
                     subprocess.run(
                         ["make", "-C", os.path.abspath(_NATIVE_DIR)],
                         capture_output=True,
                         timeout=120,
                         check=True,
                     )
-        except Exception:
-            return None
-    if not os.path.isfile(_LIB_PATH):
-        return None
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-        u8 = ctypes.POINTER(ctypes.c_uint8)
-        i8 = ctypes.POINTER(ctypes.c_int8)
-        f32 = ctypes.POINTER(ctypes.c_float)
-        i64 = ctypes.c_int64
-        lib.q40_unpack_transposed.argtypes = [u8, i64, i64, i8, f32, ctypes.c_int]
-        lib.q40_dequant_transposed.argtypes = [u8, i64, i64, f32, ctypes.c_int]
-        lib.q40_dequant.argtypes = [u8, i64, i64, f32, ctypes.c_int]
-        lib.f32_transpose.argtypes = [f32, i64, i64, f32, ctypes.c_int]
-        lib.dllama_native_version.restype = ctypes.c_int
-        if lib.dllama_native_version() != 1:  # not assert: survives python -O
-            raise RuntimeError("native library ABI version mismatch; run make clean")
+                except Exception:
+                    pass  # no toolchain: fall through to whatever exists
+            if not os.path.isfile(_LIB_PATH):
+                return None
+            lib = _open_library()
+        if lib.dllama_native_version() != _ABI_VERSION:
+            raise RuntimeError(
+                "native library ABI version mismatch; run make -C native clean"
+            )
         _lib = lib
     except Exception:
         _lib = None
@@ -123,6 +156,78 @@ def f32_transpose(arr: np.ndarray) -> np.ndarray | None:
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), _threads(),
     )
     return out
+
+
+class BpeIndex:
+    """Owns a native BPE vocab index (hash map built once). Keeps the
+    numpy arrays it points into alive for the handle's lifetime."""
+
+    def __init__(
+        self,
+        vocab_blob: np.ndarray,  # uint8 concat of all vocab pieces
+        offsets: np.ndarray,  # int64 [V + 1]
+        scores: np.ndarray,  # float32 [V]
+        regular_size: int,
+    ):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        # keep referenced buffers alive as long as the handle exists
+        self._blob = np.ascontiguousarray(vocab_blob)
+        self._offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        self._scores = np.ascontiguousarray(scores, dtype=np.float32)
+        self._handle = lib.bpe_index_new(
+            _u8ptr(self._blob),
+            self._offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            self._scores.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            len(self._scores),
+            regular_size,
+        )
+
+    def encode(
+        self, text: bytes, bos_id: int, add_specials: bool
+    ) -> list[int] | None:
+        """Token ids ([bos_id] prepended when >= 0, participating in the
+        merge phase like the Python loop's list does), or None for
+        un-tokenizable input — the caller's Python fallback raises the
+        detailed error."""
+        raw = np.frombuffer(text, dtype=np.uint8)
+        cap = max(len(text) + 8, 64)
+        out = np.empty(cap, dtype=np.int32)
+        n = self._lib.bpe_encode(
+            self._handle,
+            _u8ptr(raw) if len(raw) else _u8ptr(np.zeros(1, np.uint8)),
+            len(raw),
+            bos_id,
+            1 if add_specials else 0,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            cap,
+        )
+        if n < 0:
+            return None  # -2 untokenizable / -1 capacity
+        return out[:n].tolist()
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        lib = getattr(self, "_lib", None)
+        if handle and lib is not None:
+            try:
+                lib.bpe_index_free(handle)
+            except Exception:
+                pass
+
+
+def make_bpe_index(
+    vocab_blob: np.ndarray,
+    offsets: np.ndarray,
+    scores: np.ndarray,
+    regular_size: int,
+) -> BpeIndex | None:
+    """BpeIndex, or None when the native library is unavailable."""
+    if load_library() is None:
+        return None
+    return BpeIndex(vocab_blob, offsets, scores, regular_size)
 
 
 def q40_dequant(raw: np.ndarray, rows: int, cols: int) -> np.ndarray | None:

@@ -16,7 +16,11 @@
 
 #include <cstdint>
 #include <cstring>
+#include <queue>
+#include <string>
+#include <string_view>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -188,6 +192,184 @@ void f32_transpose(const float *in, int64_t rows, int64_t cols, float *out,
     });
 }
 
-int dllama_native_version() { return 1; }
+// Score-based BPE encode — the host-side hot loop of the tokenizer
+// (reference semantics: src/tokenizer.cpp:311-390; Python twin:
+// dllama_tpu/tokenizer/bpe.py Tokenizer.encode). The Python/reference
+// merge loop rescans all adjacent pairs per round (O(n^2)); this
+// implementation reproduces the EXACT same selection rule — highest
+// merged-token score, leftmost pair on ties, strictly-greater than the
+// -1e10 floor — with a lazy max-heap over a doubly-linked token list
+// (O(n log n)): merges never reorder surviving tokens, so "leftmost" is
+// a stable per-node order key (the original byte offset of the node's
+// first constituent), and stale heap entries are dropped by stamp
+// validation.
+//
+// The vocab index is built ONCE per tokenizer (bpe_index_new) — the
+// caller keeps the blob/offsets/scores arrays alive for the handle's
+// lifetime. A prepended BOS token participates in the merge phase
+// exactly like Python's (its list includes the BOS before merging).
+
+struct BpeIndex {
+    const uint8_t *blob;
+    const int64_t *offsets;
+    const float *scores;
+    int64_t vocab_size;
+    int64_t regular_size;
+    int64_t max_regular_len;
+    std::unordered_map<std::string_view, int32_t> regular;
+
+    std::string_view piece(int64_t id) const {
+        return std::string_view(
+            reinterpret_cast<const char *>(blob) + offsets[id],
+            (size_t)(offsets[id + 1] - offsets[id]));
+    }
+};
+
+void *bpe_index_new(const uint8_t *vocab_blob, const int64_t *offsets,
+                    const float *scores, int64_t vocab_size,
+                    int64_t regular_size) {
+    auto *ix = new BpeIndex{vocab_blob, offsets, scores,
+                            vocab_size,  regular_size, 0,
+                            {}};
+    ix->regular.reserve((size_t)regular_size * 2);
+    for (int64_t i = 0; i < regular_size; i++) {
+        // first id wins on duplicates (bpe.py builds _regular with
+        // setdefault in ascending id order)
+        ix->regular.emplace(ix->piece(i), (int32_t)i);
+        const int64_t len = offsets[i + 1] - offsets[i];
+        if (len > ix->max_regular_len) ix->max_regular_len = len;
+    }
+    return ix;
+}
+
+void bpe_index_free(void *handle) { delete (BpeIndex *)handle; }
+
+// Returns the token count, or -1 when out_cap is too small, or -2 for
+// un-tokenizable trailing bytes (the caller falls back to Python, which
+// raises the detailed error).
+int64_t bpe_encode(void *handle, const uint8_t *text, int64_t text_len,
+                   int64_t prepend_bos_id, int add_specials, int32_t *out,
+                   int64_t out_cap) {
+    const BpeIndex &ix = *(const BpeIndex *)handle;
+    const auto piece = [&](int64_t id) { return ix.piece(id); };
+    const auto &regular = ix.regular;
+    const float *scores = ix.scores;
+    const int64_t vocab_size = ix.vocab_size;
+    const int64_t regular_size = ix.regular_size;
+    const int64_t max_token_len = ix.max_regular_len;
+
+    // 1. greedy byte accumulation with special-token prefix matching at
+    //    every byte position (specials scanned in id order)
+    std::vector<int32_t> toks;
+    toks.reserve((size_t)text_len / 2 + 8);
+    if (prepend_bos_id >= 0) toks.push_back((int32_t)prepend_bos_id);
+    std::string acc;
+    int64_t i = 0;
+    const std::string_view text_sv(reinterpret_cast<const char *>(text),
+                                   (size_t)text_len);
+    while (i < text_len) {
+        if (add_specials) {
+            int64_t sid = -1;
+            for (int64_t s = regular_size; s < vocab_size; s++) {
+                std::string_view sp = piece(s);
+                if (!sp.empty() &&
+                    text_sv.compare((size_t)i, sp.size(), sp) == 0) {
+                    sid = s;
+                    break;
+                }
+            }
+            if (sid >= 0) {
+                toks.push_back((int32_t)sid);
+                i += (int64_t)piece(sid).size();
+                continue;
+            }
+        }
+        acc.push_back((char)text[i]);
+        i++;
+        auto it = regular.find(std::string_view(acc));
+        if (it != regular.end()) {
+            toks.push_back(it->second);
+            acc.clear();
+        }
+    }
+    if (!acc.empty()) return -2;
+
+    // 2. score-maximizing pair merge over a linked list + lazy heap
+    const int64_t n = (int64_t)toks.size();
+    if (n > 1) {
+        struct Node {
+            int32_t tok;
+            int64_t order;  // stable left-to-right key (never reassigned)
+            int64_t prev, next;
+            uint32_t stamp;  // bumped whenever tok changes / node dies
+            bool alive;
+        };
+        std::vector<Node> nodes((size_t)n);
+        for (int64_t j = 0; j < n; j++)
+            nodes[(size_t)j] = {toks[(size_t)j], j, j - 1,
+                                j + 1 < n ? j + 1 : -1, 0, true};
+
+        struct Cand {
+            float score;
+            int64_t order;
+            int64_t left, right;
+            uint32_t lstamp, rstamp;
+            int32_t merged;
+        };
+        struct CandLess {
+            bool operator()(const Cand &a, const Cand &b) const {
+                if (a.score != b.score) return a.score < b.score;
+                return a.order > b.order;  // leftmost wins ties
+            }
+        };
+        std::priority_queue<Cand, std::vector<Cand>, CandLess> heap;
+        std::string merged;
+        const auto push_cand = [&](int64_t l, int64_t r) {
+            const std::string_view a = piece(nodes[(size_t)l].tok);
+            const std::string_view b = piece(nodes[(size_t)r].tok);
+            if (max_token_len > 0 &&
+                (int64_t)(a.size() + b.size()) > max_token_len)
+                return;
+            merged.assign(a);
+            merged.append(b);
+            auto it = regular.find(std::string_view(merged));
+            if (it == regular.end()) return;
+            const float sc = scores[it->second];
+            if (!(sc > -1e10f)) return;  // the scan's best_score floor
+            heap.push({sc, nodes[(size_t)l].order, l, r,
+                       nodes[(size_t)l].stamp, nodes[(size_t)r].stamp,
+                       it->second});
+        };
+        for (int64_t j = 0; j + 1 < n; j++) push_cand(j, j + 1);
+
+        while (!heap.empty()) {
+            const Cand c = heap.top();
+            heap.pop();
+            Node &l = nodes[(size_t)c.left];
+            Node &r = nodes[(size_t)c.right];
+            if (!l.alive || !r.alive || l.stamp != c.lstamp ||
+                r.stamp != c.rstamp || l.next != c.right)
+                continue;  // stale entry
+            l.tok = c.merged;
+            l.stamp++;
+            r.alive = false;
+            r.stamp++;
+            l.next = r.next;
+            if (r.next >= 0) nodes[(size_t)r.next].prev = c.left;
+            if (l.prev >= 0) push_cand(l.prev, c.left);
+            if (l.next >= 0) push_cand(c.left, l.next);
+        }
+
+        toks.clear();
+        for (int64_t j = 0; j >= 0; j = nodes[(size_t)j].next)
+            if (nodes[(size_t)j].alive) toks.push_back(nodes[(size_t)j].tok);
+    }
+
+    if ((int64_t)toks.size() > out_cap) return -1;
+    std::memcpy(out, toks.data(), toks.size() * sizeof(int32_t));
+    return (int64_t)toks.size();
+}
+
+int dllama_native_version() { return 3; }
 
 }  // extern "C"

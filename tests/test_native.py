@@ -80,3 +80,43 @@ def test_f32_transpose_parity(lib):
     a = rng.standard_normal((130, 257)).astype(np.float32)  # odd sizes
     out = native.f32_transpose(a)
     np.testing.assert_array_equal(out, a.T)
+
+
+def test_bpe_encode_parity(lib, tmp_path):
+    """Native heap-based BPE vs the Python rescan loop: identical token
+    streams over random texts, specials on and off, empty input, and the
+    un-tokenizable case (native punts back to Python's detailed error)."""
+    from helpers import make_tiny_tokenizer
+    from dllama_tpu.tokenizer import Tokenizer
+
+    make_tiny_tokenizer(str(tmp_path / "t.t"))
+    tok = Tokenizer(str(tmp_path / "t.t"))
+
+    def python_encode(text, **kw):
+        saved = tok._encode_native
+        tok._encode_native = lambda raw, sp, bos: None
+        try:
+            return tok.encode(text, **kw)
+        finally:
+            tok._encode_native = saved
+
+    rng = np.random.default_rng(9)
+    cases = [
+        "hello world",
+        "",
+        "the quick brown fox jumps over the lazy dog " * 10,
+        "<s>special</s> mixed <|eot|> text",
+        "émojis 🦙 and ünïcode",
+    ]
+    for _ in range(20):
+        n = int(rng.integers(1, 200))
+        cases.append(bytes(rng.integers(32, 127, n).astype(np.uint8)).decode())
+    for text in cases:
+        for sp in (True, False):
+            got = tok.encode(text, add_special_tokens=sp)
+            want = python_encode(text, add_special_tokens=sp)
+            assert got == want, (text[:40], sp, got[:10], want[:10])
+
+    # multi-byte UTF-8 straddling merges
+    s = "ααββγγ" * 30
+    assert tok.encode(s) == python_encode(s)
