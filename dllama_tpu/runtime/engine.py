@@ -684,7 +684,14 @@ class InferenceEngine:
         fitting = [b for b in self.prefill_buckets if b <= space]
         if not fitting:
             # guarded by the prefill bounds check: space >= 1 and bucket 1
-            # may not be configured; fall back to exact width
+            # may not be configured; fall back to exact width. Under sp a
+            # chunk wider than 1 shards its query axis over sp chips, so
+            # round down to a shardable width (width-1 chunks go through
+            # the merged-stats branch instead and are always valid).
+            if self.sp > 1 and space % self.sp:
+                space -= space % self.sp
+                if space == 0:
+                    return 1
             return space
         for b in fitting:
             if n <= b:

@@ -75,24 +75,27 @@ def load_library(auto_build: bool = True):
         return _lib
     _lib_tried = True
     try:
-        import fcntl
+        if auto_build and _needs_build():
+            # build + dlopen under one lock so no process opens a .so
+            # another's make is mid-way through writing; a current .so
+            # takes the lock-free fast path (works on read-only installs)
+            try:
+                import fcntl
 
-        lock_path = _LIB_PATH + ".lock"
-        with open(lock_path, "w") as lock:
-            fcntl.flock(lock, fcntl.LOCK_EX)
-            if auto_build and _needs_build():
-                try:
-                    subprocess.run(
-                        ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                        capture_output=True,
-                        timeout=120,
-                        check=True,
-                    )
-                except Exception:
-                    pass  # no toolchain: fall through to whatever exists
-            if not os.path.isfile(_LIB_PATH):
-                return None
-            lib = _open_library()
+                with open(_LIB_PATH + ".lock", "w") as lock:
+                    fcntl.flock(lock, fcntl.LOCK_EX)
+                    if _needs_build():
+                        subprocess.run(
+                            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                            capture_output=True,
+                            timeout=120,
+                            check=True,
+                        )
+            except Exception:
+                pass  # no toolchain / read-only tree: use whatever exists
+        if not os.path.isfile(_LIB_PATH):
+            return None
+        lib = _open_library()
         if lib.dllama_native_version() != _ABI_VERSION:
             raise RuntimeError(
                 "native library ABI version mismatch; run make -C native clean"
@@ -184,6 +187,11 @@ class BpeIndex:
             len(self._scores),
             regular_size,
         )
+        if not self._handle:
+            raise RuntimeError(
+                f"native BPE index rejected vocab (regular_size="
+                f"{regular_size}, vocab={len(self._scores)})"
+            )
 
     def encode(
         self, text: bytes, bos_id: int, add_specials: bool
@@ -224,10 +232,14 @@ def make_bpe_index(
     scores: np.ndarray,
     regular_size: int,
 ) -> BpeIndex | None:
-    """BpeIndex, or None when the native library is unavailable."""
+    """BpeIndex, or None when the native library is unavailable or the
+    vocab metadata is rejected (callers fall back to the Python loop)."""
     if load_library() is None:
         return None
-    return BpeIndex(vocab_blob, offsets, scores, regular_size)
+    try:
+        return BpeIndex(vocab_blob, offsets, scores, regular_size)
+    except RuntimeError:
+        return None
 
 
 def q40_dequant(raw: np.ndarray, rows: int, cols: int) -> np.ndarray | None:

@@ -228,6 +228,11 @@ struct BpeIndex {
 void *bpe_index_new(const uint8_t *vocab_blob, const int64_t *offsets,
                     const float *scores, int64_t vocab_size,
                     int64_t regular_size) {
+    // a malformed .t header can leave bos_id (= regular split) at -1;
+    // returning null lets the Python side fall back to its own loop and
+    // raise a catchable error instead of aborting through the C ABI
+    if (regular_size < 0 || regular_size > vocab_size || vocab_size < 0)
+        return nullptr;
     auto *ix = new BpeIndex{vocab_blob, offsets, scores,
                             vocab_size,  regular_size, 0,
                             {}};

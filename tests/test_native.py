@@ -120,3 +120,35 @@ def test_bpe_encode_parity(lib, tmp_path):
     # multi-byte UTF-8 straddling merges
     s = "ααββγγ" * 30
     assert tok.encode(s) == python_encode(s)
+
+
+def test_bpe_encode_tie_break_leftmost(lib):
+    """Equal merge scores: the heap must pick the LEFTMOST pair, exactly
+    like the Python rescan loop's strictly-greater comparison does.
+    Vocab: a,b,c + ab,bc with EQUAL scores — "abc" must merge (a,b)
+    first -> [ab, c], not [a, bc]."""
+    from dllama_tpu.formats.tokenizer_file import TokenizerData
+    from dllama_tpu.tokenizer import Tokenizer
+
+    vocab = [b"a", b"b", b"c", b"ab", b"bc", b"<s>"]
+    scores = [0.0, 0.0, 0.0, 5.0, 5.0, 0.0]
+    data = TokenizerData(
+        vocab=vocab, scores=scores, bos_id=5, add_bos=False,
+        eos_token_ids=[], chat_template=None, max_token_length=3,
+    )
+    tok = Tokenizer(data)
+
+    def python_encode(text):
+        saved = tok._encode_native
+        tok._encode_native = lambda raw, sp, bos: None
+        try:
+            return tok.encode(text)
+        finally:
+            tok._encode_native = saved
+
+    for text in ("abc", "abcabc", "abcbcab", "aabbcc", "cabcab"):
+        got = tok.encode(text)
+        want = python_encode(text)
+        assert got == want, (text, got, want)
+    # the canonical tie: leftmost pair wins
+    assert tok.encode("abc") == [3, 2]  # [ab, c]
