@@ -434,6 +434,7 @@ def forward(
     moe_gather_max_tokens: int = 0,
     attn_window: int = 0,
     attn_park_threshold: int = 0,
+    logits_mode: str = "all",
 ) -> Tuple[jnp.ndarray, KvCache]:
     """Run the decoder on T tokens starting at absolute position `pos`.
 
@@ -456,6 +457,12 @@ def forward(
     entirely (position pushed strongly negative), so an idle or prefilling
     -elsewhere lane costs one skipped-compute block instead of a full
     cache scan, and its discarded output is exactly zero.
+
+    `logits_mode` (static): "all" -> logits [B, T, V]; "last" -> [B, 1, V],
+    computing the final norm + vocab matmul on the last chunk row only —
+    prefill chunks only sample from their last row, and for small models
+    the vocab matmul is a large fraction of chunk FLOPs (~25% on a
+    1B/128k-vocab shape), which lands directly on TTFT.
     """
     b, t = tokens.shape
     interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
@@ -566,6 +573,10 @@ def forward(
     )
 
     # final norm + logits (reference: src/llm.cpp:560-599)
+    if logits_mode not in ("all", "last"):
+        raise ValueError(f"unknown logits_mode: {logits_mode!r}")
+    if logits_mode == "last":
+        x = x[:, -1:, :]
     y = rms_norm(x, params["final_norm"], h.norm_epsilon)
     wcls = params["wcls"]
     if isinstance(wcls, QuantWeight):

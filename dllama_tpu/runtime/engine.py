@@ -296,7 +296,8 @@ class InferenceEngine:
             )
             with ctx:
                 logits, cache = forward(
-                    params, h, tokens, pos, cache, mesh=mesh, attn_window=window
+                    params, h, tokens, pos, cache, mesh=mesh,
+                    attn_window=window, logits_mode="last",
                 )
             last = logits[:, -1, :]
             if greedy:
@@ -336,7 +337,7 @@ class InferenceEngine:
                 with ctx:
                     logits, cache = forward(
                         params, h, tok, pos + i, cache, mesh=mesh,
-                        attn_window=window,
+                        attn_window=window, logits_mode="last",
                     )
                 last = logits[:, -1, :]
                 if greedy:
@@ -530,6 +531,7 @@ class InferenceEngine:
                 _, cache = forward(
                     params, h, tokens, pos_vec, cache, mesh=mesh,
                     attn_window=window, attn_park_threshold=park,
+                    logits_mode="last",
                 )
             return cache
 
@@ -604,7 +606,7 @@ class InferenceEngine:
                 with ctx:
                     logits, cache = forward(
                         params, h, tok, cur, cache, mesh=mesh,
-                        attn_park_threshold=park,
+                        attn_park_threshold=park, logits_mode="last",
                     )
                 last = logits[:, -1, :]
                 nxt = _sample_on_device(

@@ -113,6 +113,31 @@ def test_batch_axis(tmp_path):
     )
 
 
+def test_logits_mode_last_matches_all(tmp_path):
+    """logits_mode='last' must equal the full computation's final row and
+    produce the identical updated cache (prefill chunks only sample from
+    their last row; the vocab matmul on the other rows is skipped)."""
+    h, params, _ = build(tmp_path)
+    tokens = jnp.asarray([TOKENS], dtype=jnp.int32)
+    cache_a = init_kv_cache(h, batch_size=1)
+    logits_all, cache_all = forward(params, h, tokens, jnp.int32(0), cache_a)
+    cache_b = init_kv_cache(h, batch_size=1)
+    logits_last, cache_last = forward(
+        params, h, tokens, jnp.int32(0), cache_b, logits_mode="last"
+    )
+    assert logits_last.shape == (1, 1, h.vocab_size)
+    np.testing.assert_allclose(
+        np.asarray(logits_last)[:, 0], np.asarray(logits_all)[:, -1],
+        rtol=1e-6, atol=1e-6,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cache_last["k"]), np.asarray(cache_all["k"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cache_last["v"]), np.asarray(cache_all["v"])
+    )
+
+
 def test_forward_parked_lane_isolation(tmp_path):
     """Per-lane forward with a parked lane (attn_park_threshold): the
     active lane's logits must equal a solo run, the parked lane's writes
