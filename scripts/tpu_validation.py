@@ -218,6 +218,27 @@ def main() -> None:
     del ed
     record("engine q40 == dense tokens", "OK" if outq == outd else f"FAIL {outq} {outd}")
 
+    # 4b. per-lane serving on silicon: parked prefill + per-lane decode
+    # (the per-lane flash-decode clamp and parked-lane masking lower
+    # through Mosaic for the first time here)
+    eb = InferenceEngine(d + "/m.m", tp=1, dtype=jnp.bfloat16,
+                         temperature=0.0, weight_format="q40", batch_size=2)
+    prompts = [[1, 2, 3, 4], [9, 8, 7, 6, 5]]
+    singles = []
+    es = InferenceEngine(d + "/m.m", tp=1, dtype=jnp.bfloat16,
+                         temperature=0.0, weight_format="q40")
+    for p in prompts:
+        es.reset()
+        o, _, _ = es.generate(p, max_steps=20)
+        singles.append(o)
+    del es
+    outs = eb.generate_batch(prompts, max_steps=20)
+    record(
+        "engine lanes == single-stream tokens",
+        "OK" if outs == singles else f"FAIL {outs} {singles}",
+    )
+    del eb
+
     # 5. decode throughput
     import subprocess
 
