@@ -287,6 +287,31 @@ def main():
         except Exception as e:
             print(f"F qmm-flat bn={bn}: {type(e).__name__}: {e}")
 
+    # G. kernel-launch overhead probe: decode runs 7 quantized matmuls per
+    # layer; if N small calls cost meaningfully more than one call over
+    # the same bytes, qkv/w1w3 fusion (ROADMAP #3) is worth the layout
+    # complexity.
+    n_split = 4
+    n_small = n // n_split
+    if n % n_split == 0 and n_small % 128 == 0:
+        f_one = jax.jit(lambda: qmatmul_2d(x, wq_j, wd_j, block_n=512))
+        qs = [jnp.asarray(wq[:, i * n_small:(i + 1) * n_small]) for i in range(n_split)]
+        ds = [jnp.asarray(wd[:, i * n_small:(i + 1) * n_small]) for i in range(n_split)]
+
+        def f_many():
+            outs = [
+                qmatmul_2d(x, qs[i], ds[i], block_n=min(512, n_small))
+                for i in range(n_split)
+            ]
+            return outs[-1]
+
+        f_many_j = jax.jit(f_many)
+        t_one = timeit(f_one)
+        t_many = timeit(f_many_j)
+        report("G one fused call", t_one, q_bytes)
+        report(f"G {n_split} split calls (same bytes)", t_many, q_bytes)
+        print(f"  -> per-call overhead ~{(t_many - t_one) / (n_split - 1):.3f} ms")
+
     # correctness spot check for the variants that could ship
     from dllama_tpu.ops.quant_matmul import QuantWeight, qmatmul_ref
 
