@@ -16,9 +16,11 @@ Layer walk per token (reference: src/llm.cpp:263-557):
 
 Shapes: tokens [B, T] -> logits [B, T, V]. The reference is B=1 with T the
 prefill chunk (its `nBatches`); we keep a real batch axis as a data-parallel
-surface. The KV cache is [L, B, S, nKvHeads, headDim] — the kv-head axis is
-the tensor-parallel shard axis, mirroring the reference's KV split
-(sliceKvCache, src/nn/nn-core.cpp:211-218).
+surface. The KV cache is [L, B, nKvHeads, S, headDim] (HEAD-MAJOR) — the
+kv-head axis is the tensor-parallel shard axis, mirroring the reference's
+KV split (sliceKvCache, src/nn/nn-core.cpp:211-218), and per-head (S, hd)
+planes are what the Pallas flash kernels tile (Mosaic's last-two-dims rule
+rejects blocking a size-1 head dim; see ops/flash_attention.py).
 """
 
 from __future__ import annotations
@@ -61,7 +63,7 @@ def init_kv_cache(
     """Allocate the KV cache (reference allocates per-layer f32 k/v buffers,
     src/llm.cpp:260-261)."""
     s = seq_len or h.seq_len
-    shape = (h.n_layers, batch_size, s, h.n_kv_heads, h.head_dim)
+    shape = (h.n_layers, batch_size, h.n_kv_heads, s, h.head_dim)
     return {
         "k": jnp.zeros(shape, dtype=dtype),
         "v": jnp.zeros(shape, dtype=dtype),
@@ -70,8 +72,8 @@ def init_kv_cache(
 
 def _attention_tp(
     q: jnp.ndarray,  # [B, T, H, hd]
-    k_cache: jnp.ndarray,  # [B, S, KH, hd]
-    v_cache: jnp.ndarray,  # [B, S, KH, hd]
+    k_cache: jnp.ndarray,  # [B, KH, S, hd]
+    v_cache: jnp.ndarray,  # [B, KH, S, hd]
     pos: jnp.ndarray,
     head_dim: int,
     mesh,
@@ -95,7 +97,7 @@ def _attention_tp(
             )
         return _attention_sp(q, k_cache, v_cache, pos, head_dim, mesh)
     on_tpu = jax.default_backend() == "tpu"
-    s = k_cache.shape[1]
+    s = k_cache.shape[2]
     if on_tpu and t == 1 and pick_decode_block(s) is not None:
         kernel = flash_decode  # handles scalar and per-lane pos
     elif on_tpu and t >= 8 and pick_flash_blocks(t, s) is not None:
@@ -110,7 +112,7 @@ def _attention_tp(
         from jax.sharding import PartitionSpec as P
 
         spec_q = P("dp", None, "tp", None)
-        spec_kv = P("dp", None, "tp", None)
+        spec_kv = P("dp", "tp", None, None)
         pos_spec = P("dp") if per_lane else P()
         out = shard_map(
             lambda qq, kk, vv, pp: kernel(qq, kk, vv, pp),
@@ -124,7 +126,7 @@ def _attention_tp(
 
 def _attention_sp(
     q: jnp.ndarray,  # [B, T, H, hd]
-    k_cache: jnp.ndarray,  # [B, S, KH, hd] — S sharded over "sp"
+    k_cache: jnp.ndarray,  # [B, KH, S, hd] — S sharded over "sp"
     v_cache: jnp.ndarray,
     pos: jnp.ndarray,
     head_dim: int,
@@ -151,10 +153,10 @@ def _attention_sp(
     from ..parallel.ring_attention import ring_attention_local
 
     b, t, n_heads = q.shape[0], q.shape[1], q.shape[2]
-    s = k_cache.shape[1]
+    s = k_cache.shape[2]
     sp = mesh.shape["sp"]
     shard = s // sp
-    kv_spec = P("dp", "sp", "tp", None)
+    kv_spec = P("dp", "tp", "sp", None)
 
     if t == 1:
         q_spec = P("dp", None, "tp", None)
@@ -223,8 +225,8 @@ def _attention_sp(
 
 def _attention(
     q: jnp.ndarray,  # [B, T, H, hd]
-    k_cache: jnp.ndarray,  # [B, S, KH, hd]
-    v_cache: jnp.ndarray,  # [B, S, KH, hd]
+    k_cache: jnp.ndarray,  # [B, KH, S, hd]
+    v_cache: jnp.ndarray,  # [B, KH, S, hd]
     pos: jnp.ndarray,  # scalar int32: absolute position of tokens[:, 0]
     head_dim: int,
 ) -> jnp.ndarray:
@@ -477,7 +479,7 @@ def forward(
         # The sentinel must stay negative for every query row of a T-wide
         # chunk, hence -(cache length).
         attn_pos = jnp.where(
-            pos >= attn_park_threshold, -cache["k"].shape[2], pos
+            pos >= attn_park_threshold, -cache["k"].shape[3], pos
         )
     else:
         attn_pos = pos
@@ -494,14 +496,15 @@ def forward(
 
     def _cache_append(cache_l, val):
         """Write the chunk at each lane's position (reference: OP_SHIFT,
-        src/nn/nn-cpu-ops.cpp:1419-1441) -> dynamic_update_slice, vmapped
-        over lanes when positions differ."""
-        val = val.astype(cache_l.dtype)
+        src/nn/nn-cpu-ops.cpp:1419-1441) -> dynamic_update_slice on the
+        head-major cache's S axis, vmapped over lanes when positions
+        differ. `val` arrives [B, T, KH, hd] from the projection."""
+        val = val.astype(cache_l.dtype).transpose(0, 2, 1, 3)  # [B, KH, T, hd]
         if per_lane:
             return jax.vmap(
-                lambda c, u, p: lax.dynamic_update_slice_in_dim(c, u, p, axis=0)
+                lambda c, u, p: lax.dynamic_update_slice_in_dim(c, u, p, axis=1)
             )(cache_l, val, pos)
-        return lax.dynamic_update_slice_in_dim(cache_l, val, pos, axis=1)
+        return lax.dynamic_update_slice_in_dim(cache_l, val, pos, axis=2)
 
     def layer_step(x, layer):
         lp, k_cache_l, v_cache_l = layer
@@ -520,9 +523,9 @@ def forward(
         k_cache_l = _cache_append(k_cache_l, k)
         v_cache_l = _cache_append(v_cache_l, v)
 
-        if attn_window and attn_window < k_cache_l.shape[1]:
-            k_view = k_cache_l[:, :attn_window]
-            v_view = v_cache_l[:, :attn_window]
+        if attn_window and attn_window < k_cache_l.shape[2]:
+            k_view = k_cache_l[:, :, :attn_window]
+            v_view = v_cache_l[:, :, :attn_window]
         else:
             k_view, v_view = k_cache_l, v_cache_l
         z = _attention_tp(q, k_view, v_view, attn_pos, h.head_dim, mesh)

@@ -16,8 +16,11 @@ Kernel layout: grid (B * H, T blocks, S blocks), S innermost so the online
 softmax state (m, l, acc) lives in VMEM scratch across S steps. S blocks
 entirely above the causal frontier are compute-skipped via pl.when AND
 DMA-skipped via a clamped kv index map (a repeated block index elides the
-HBM->VMEM copy), with the cache consumed in its native [B, S, KH, hd]
-layout so no transposed copy of it is ever materialized.
+HBM->VMEM copy). The cache is HEAD-MAJOR [B, KH, S, hd]: each grid step's
+kv tile is a (block_s, hd) plane of one head, which satisfies Mosaic's
+last-two-dims tiling rule for any head_dim (a [B, S, KH, hd] layout would
+need an illegal size-1 head block inside the last two dims — rejected on
+real silicon) and avoids (KH, hd) -> (8, 128) tile padding in HBM.
 """
 
 from __future__ import annotations
@@ -46,8 +49,8 @@ def pick_flash_blocks(t: int, s: int) -> tuple[int, int] | None:
 
 def attention_ref(
     q: jnp.ndarray,  # [B, T, H, hd]
-    k_cache: jnp.ndarray,  # [B, S, KH, hd]
-    v_cache: jnp.ndarray,  # [B, S, KH, hd]
+    k_cache: jnp.ndarray,  # [B, KH, S, hd]
+    v_cache: jnp.ndarray,  # [B, KH, S, hd]
     pos: jnp.ndarray,  # scalar int32
 ) -> jnp.ndarray:
     """jnp reference: the canonical masked-softmax math from ops/jnp_ops
@@ -61,8 +64,8 @@ def _flash_stats_kernel(
     pos_ref,  # SMEM scalar prefetch: [B] int32 per-lane q start positions
     spos_ref,  # SMEM scalar prefetch: [1] int32 (s_pos0)
     q_ref,  # [1, bt, hd]
-    k_ref,  # [1, bs, 1, hd] — native-layout cache tile (no pre-transpose)
-    v_ref,  # [1, bs, 1, hd]
+    k_ref,  # [1, 1, bs, hd] — one head's (seq, hd) plane
+    v_ref,  # [1, 1, bs, hd]
     acc_out,  # [1, bt, hd]
     m_out,  # [1, bt, 128]
     l_out,  # [1, bt, 128]
@@ -99,7 +102,7 @@ def _flash_stats_kernel(
     @pl.when(s_start <= q_pos0 + block_t - 1)
     def _compute():
         q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0, :, 0, :].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
         scores = (
             jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -118,7 +121,7 @@ def _flash_stats_kernel(
         p = jnp.where(m_new <= _NEG_INF / 2, 0.0, p)
         alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -139,8 +142,8 @@ def _flash_stats_kernel(
 )
 def flash_attention_stats(
     q: jnp.ndarray,  # [B, T, H, hd]
-    k: jnp.ndarray,  # [B, S, KH, hd]
-    v: jnp.ndarray,  # [B, S, KH, hd]
+    k: jnp.ndarray,  # [B, KH, S, hd]
+    v: jnp.ndarray,  # [B, KH, S, hd]
     q_pos0: jnp.ndarray,  # scalar or [B] int32: position of q[:, 0] per lane
     s_pos0: jnp.ndarray,  # scalar int32: absolute position of k[:, 0]
     block_t: int = 0,
@@ -153,7 +156,7 @@ def flash_attention_stats(
     gives each lane its own query start (per-lane prefill); a strongly
     negative lane position masks that lane entirely at one block of DMA."""
     b, t, h, hd = q.shape
-    s, kh = k.shape[1], k.shape[2]
+    kh, s = k.shape[1], k.shape[2]
     g = h // kh
     if not block_t or not block_s:
         picked = pick_flash_blocks(t, s)
@@ -173,9 +176,9 @@ def flash_attention_stats(
     n_s = s // block_s
     scale = 1.0 / (hd**0.5)
 
-    # queries transpose is chunk-sized (cheap); the cache stays in its
-    # native [B, S, KH, hd] layout — a pre-transpose would copy all S rows
-    # per call
+    # queries transpose is chunk-sized (cheap); the cache is consumed in
+    # its storage layout [B, KH, S, hd] — no copy of the S rows is ever
+    # materialized
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
     pos_arr = jnp.broadcast_to(
         jnp.atleast_1d(jnp.asarray(q_pos0, jnp.int32)), (b,)
@@ -194,7 +197,7 @@ def flash_attention_stats(
             // block_s,
             0,
         )
-        return (bh // h, jnp.minimum(si, limit), (bh % h) // g, 0)
+        return (bh // h, (bh % h) // g, jnp.minimum(si, limit), 0)
 
     acc, m, l = pl.pallas_call(
         functools.partial(
@@ -210,8 +213,8 @@ def flash_attention_stats(
             grid=(b * h, n_t, n_s),
             in_specs=[
                 pl.BlockSpec((1, block_t, hd), q_map),
-                pl.BlockSpec((1, block_s, 1, hd), kv_map),
-                pl.BlockSpec((1, block_s, 1, hd), kv_map),
+                pl.BlockSpec((1, 1, block_s, hd), kv_map),
+                pl.BlockSpec((1, 1, block_s, hd), kv_map),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_t, hd), q_map),
@@ -243,8 +246,8 @@ def _flash_decode_kernel(
     pos_ref,  # SMEM scalar prefetch: [B] int32 (per-lane query positions)
     spos_ref,  # SMEM scalar prefetch: [1] int32 (this KV shard's first pos)
     q_ref,  # [1, G, hd] (the G query heads sharing this KV head)
-    k_ref,  # [1, bs, 1, hd] — a native-layout cache tile (no pre-transpose)
-    v_ref,  # [1, bs, 1, hd]
+    k_ref,  # [1, 1, bs, hd] — one head's (seq, hd) plane
+    v_ref,  # [1, 1, bs, hd]
     *rest,  # emit_stats: (acc_out [1,G,hd], m_out [1,G,128], l_out [1,G,128])
     #         else: (o_ref [1,G,hd]); then scratch (m_ref, l_ref, acc_ref)
     block_s: int,
@@ -288,7 +291,7 @@ def _flash_decode_kernel(
     def _compute():
         g = q_ref.shape[1]
         q = q_ref[0].astype(jnp.float32)  # [G, hd]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bs, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bs, hd]
         scores = (
             jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
@@ -308,7 +311,7 @@ def _flash_decode_kernel(
         p = jnp.where(m_new <= _NEG_INF / 2, 0.0, p)
         alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -344,8 +347,8 @@ def pick_decode_block(s: int) -> int | None:
 )
 def _flash_decode_impl(
     q: jnp.ndarray,  # [B, 1, H, hd]
-    k_cache: jnp.ndarray,  # [B, S, KH, hd]
-    v_cache: jnp.ndarray,  # [B, S, KH, hd]
+    k_cache: jnp.ndarray,  # [B, KH, S, hd]
+    v_cache: jnp.ndarray,  # [B, KH, S, hd]
     pos: jnp.ndarray,  # scalar int32, or [B] per-lane positions
     s_pos0: jnp.ndarray,  # scalar int32: absolute position of cache row 0
     block_s: int = 0,
@@ -361,13 +364,13 @@ def _flash_decode_impl(
     [G, hd] x [hd, block_s] matmul per KV block), and the kv BlockSpec
     index map clamps at pos's block so the pipeline only moves ~pos rows
     of cache per step regardless of allocated seq_len. The cache is
-    consumed in its NATIVE [B, S, KH, hd] layout via 4-D BlockSpecs — a
-    pre-transpose would materialize a full copy of the cache per step and
-    defeat the whole point.
+    consumed in its storage layout [B, KH, S, hd] via 4-D BlockSpecs — no
+    per-step copy/transpose of the cache is ever materialized, and each
+    tile is a Mosaic-legal (block_s, hd) plane.
     """
     b, t, h, hd = q.shape
     assert t == 1, "flash_decode is the T=1 path"
-    s, kh = k_cache.shape[1], k_cache.shape[2]
+    kh, s = k_cache.shape[1], k_cache.shape[2]
     g = h // kh
     if not block_s:
         picked = pick_decode_block(s)
@@ -396,7 +399,7 @@ def _flash_decode_impl(
         # clamp: revisiting the same block index elides the DMA, so blocks
         # beyond this lane's pos cost no HBM traffic
         limit = jnp.maximum(pos_ref[bk // kh] - spos_ref[0], 0)
-        return (bk // kh, jnp.minimum(si, limit // block_s), bk % kh, 0)
+        return (bk // kh, bk % kh, jnp.minimum(si, limit // block_s), 0)
 
     kernel = functools.partial(
         _flash_decode_kernel,
@@ -411,8 +414,8 @@ def _flash_decode_impl(
         grid=(b * kh, n_s),
         in_specs=[
             pl.BlockSpec((1, g, hd), q_map),
-            pl.BlockSpec((1, block_s, 1, hd), kv_map),
-            pl.BlockSpec((1, block_s, 1, hd), kv_map),
+            pl.BlockSpec((1, 1, block_s, hd), kv_map),
+            pl.BlockSpec((1, 1, block_s, hd), kv_map),
         ],
         out_specs=(
             [
@@ -458,7 +461,7 @@ def _flash_decode_impl(
 
 def flash_decode(
     q: jnp.ndarray,  # [B, 1, H, hd]
-    k_cache: jnp.ndarray,  # [B, S, KH, hd]
+    k_cache: jnp.ndarray,  # [B, KH, S, hd]
     v_cache: jnp.ndarray,
     pos: jnp.ndarray,  # scalar int32, or [B] per-lane positions
     block_s: int = 0,
@@ -473,7 +476,7 @@ def flash_decode(
 
 def flash_decode_stats(
     q: jnp.ndarray,  # [B, 1, H, hd]
-    k_cache: jnp.ndarray,  # [B, Ss, KH, hd] — one sequence SHARD
+    k_cache: jnp.ndarray,  # [B, KH, Ss, hd] — one sequence SHARD
     v_cache: jnp.ndarray,
     pos: jnp.ndarray,  # scalar or [B]
     s_pos0: jnp.ndarray,  # absolute position of this shard's row 0
@@ -495,8 +498,8 @@ def flash_decode_stats(
 
 def flash_attention(
     q: jnp.ndarray,  # [B, T, H, hd]
-    k_cache: jnp.ndarray,  # [B, S, KH, hd]
-    v_cache: jnp.ndarray,  # [B, S, KH, hd]
+    k_cache: jnp.ndarray,  # [B, KH, S, hd]
+    v_cache: jnp.ndarray,  # [B, KH, S, hd]
     pos: jnp.ndarray,  # scalar int32, or [B] per-lane positions
     block_t: int = 0,
     block_s: int = 0,

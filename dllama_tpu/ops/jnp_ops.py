@@ -142,24 +142,30 @@ _NEG_INF = -1e30
 
 def attention_stats(
     q: jnp.ndarray,  # [B, Tq, H, hd]
-    k: jnp.ndarray,  # [B, Ts, KH, hd]
-    v: jnp.ndarray,  # [B, Ts, KH, hd]
+    k: jnp.ndarray,  # [B, KH, Ts, hd] — head-major cache layout
+    v: jnp.ndarray,  # [B, KH, Ts, hd]
     q_pos0,  # scalar or [B]: absolute position of q[:, 0] (per lane)
-    s_pos0,  # scalar: absolute position of k[:, 0]
+    s_pos0,  # scalar: absolute position of k[:, :, 0]
 ):
     """Causal GQA attention partial state (unnormalized acc, running max m,
     denominator l) in f32 — the single source of the reference's
     multiheadAtt_F32 math (src/nn/nn-cpu-ops.cpp:753-788). Dense attention
     normalizes it directly; ring attention merges several of these across
     sequence shards. A vector ``q_pos0`` gives each batch lane its own
-    position (independent decode lanes)."""
+    position (independent decode lanes).
+
+    The cache is HEAD-MAJOR ([B, KH, S, hd]): per-KV-head tiles are then
+    (seq, head_dim) planes whose Pallas BlockSpecs satisfy Mosaic's
+    last-two-dims tiling rule for any head_dim — blocking a size-1 head
+    inside the last two dims of a [B, S, KH, hd] array is rejected by the
+    real TPU compiler (and pads (KH, hd) tiles up to (8, 128))."""
     b, tq, h, hd = q.shape
-    ts, kh = k.shape[1], k.shape[2]
+    kh, ts = k.shape[1], k.shape[2]
     g = h // kh
     qf = q.astype(jnp.float32).reshape(b, tq, kh, g, hd)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
-    scores = jnp.einsum("btkgh,bskh->bkgts", qf, kf) / jnp.sqrt(jnp.float32(hd))
+    scores = jnp.einsum("btkgh,bksh->bkgts", qf, kf) / jnp.sqrt(jnp.float32(hd))
     q_pos0_arr = jnp.atleast_1d(jnp.asarray(q_pos0, jnp.int32))  # [1] or [B]
     q_pos = q_pos0_arr[:, None] + jnp.arange(tq, dtype=jnp.int32)[None, :]
     s_pos = s_pos0 + jnp.arange(ts, dtype=jnp.int32)
@@ -170,13 +176,13 @@ def attention_stats(
     # fully-masked rows (query before every key in this shard) -> zero
     p = jnp.where(m[..., None] <= _NEG_INF / 2, 0.0, p)
     l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bkgts,bskh->bkgth", p, vf)
+    acc = jnp.einsum("bkgts,bksh->bkgth", p, vf)
     return acc, m, l
 
 
 def attention_dense(
     q: jnp.ndarray,  # [B, T, H, hd]
-    k_cache: jnp.ndarray,  # [B, S, KH, hd]
+    k_cache: jnp.ndarray,  # [B, KH, S, hd]
     v_cache: jnp.ndarray,
     pos,  # scalar: absolute position of q[:, 0]
 ) -> jnp.ndarray:

@@ -71,7 +71,7 @@ def _merge_stats(acc1, m1, l1, acc2, m2, l2):
 
 def ring_attention_local(
     q: jnp.ndarray,  # [B, Tq, H, hd] this chip's query shard
-    k: jnp.ndarray,  # [B, Ss, KH, hd] this chip's KV shard
+    k: jnp.ndarray,  # [B, KH, Ss, hd] this chip's KV shard (head-major)
     v: jnp.ndarray,
     q_pos0: jnp.ndarray,  # absolute position of this chip's first query
     shard_size: jnp.ndarray,  # sequence length held per chip (Ss)
@@ -100,7 +100,7 @@ def ring_attention_local(
         return (k_nxt, v_nxt, owner, acc, m, l), None
 
     b, tq, h, hd = q.shape
-    kh = k.shape[2]
+    kh = k.shape[1]
     g = h // kh
     acc0 = jnp.zeros((b, kh, g, tq, hd), jnp.float32)
     m0 = jnp.full((b, kh, g, tq), _NEG_INF, jnp.float32)
@@ -127,7 +127,7 @@ def ring_attention_local(
 
 def ring_attention(
     q: jnp.ndarray,  # [B, T, H, hd] global queries
-    k: jnp.ndarray,  # [B, S, KH, hd] global keys (S = T for self-attention)
+    k: jnp.ndarray,  # [B, KH, S, hd] global keys (S = T for self-attention)
     v: jnp.ndarray,
     mesh,
     q_pos0: int | jnp.ndarray = 0,
@@ -146,7 +146,7 @@ def ring_attention(
 
     sp = mesh.shape[axis_name]
     b, t, h, hd = q.shape
-    s = k.shape[1]
+    s = k.shape[2]
     assert t % sp == 0 and s % sp == 0, (t, s, sp)
     shard_size = s // sp
     tq = t // sp
@@ -171,11 +171,12 @@ def ring_attention(
             interpret=interpret,
         )
 
-    spec = P(None, axis_name, None, None)
+    q_spec = P(None, axis_name, None, None)
+    kv_spec = P(None, None, axis_name, None)
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
         check_vma=False,
     )(q, k, v)

@@ -64,13 +64,13 @@ def param_spec_tree(h: LlmHeader) -> dict[str, Any]:
 
 
 def cache_specs(h: LlmHeader, sp: bool = False) -> dict[str, P]:
-    """KV cache [L, B, S, KH, hd]: batch over dp, kv-heads over tp
-    (reference: sliceKvCache, src/nn/nn-core.cpp:211-218). With `sp` the
-    sequence axis additionally shards over the sp mesh axis — the
+    """KV cache [L, B, KH, S, hd] (head-major): batch over dp, kv-heads
+    over tp (reference: sliceKvCache, src/nn/nn-core.cpp:211-218). With
+    `sp` the sequence axis additionally shards over the sp mesh axis — the
     long-context layout ring/merged attention consumes
     (models/transformer._attention_sp)."""
     spec = (
-        P(None, "dp", "sp", "tp", None) if sp else P(None, "dp", None, "tp", None)
+        P(None, "dp", "tp", "sp", None) if sp else P(None, "dp", "tp", None, None)
     )
     return {"k": spec, "v": spec}
 

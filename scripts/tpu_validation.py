@@ -64,8 +64,9 @@ def main() -> None:
     from dllama_tpu.ops.flash_attention import attention_ref, flash_attention
 
     q = jnp.asarray(rng.standard_normal((1, 128, 8, 64)).astype(np.float32)).astype(jnp.bfloat16)
-    kc = jnp.asarray(rng.standard_normal((1, 1024, 4, 64)).astype(np.float32)).astype(jnp.bfloat16)
-    vc = jnp.asarray(rng.standard_normal((1, 1024, 4, 64)).astype(np.float32)).astype(jnp.bfloat16)
+    # head-major cache layout [B, KH, S, hd]
+    kc = jnp.asarray(rng.standard_normal((1, 4, 1024, 64)).astype(np.float32)).astype(jnp.bfloat16)
+    vc = jnp.asarray(rng.standard_normal((1, 4, 1024, 64)).astype(np.float32)).astype(jnp.bfloat16)
     fo = flash_attention(q, kc, vc, jnp.int32(512))
     fr = attention_ref(q, kc, vc, jnp.int32(512))
     rel = float(
@@ -87,8 +88,8 @@ def main() -> None:
 
     S = 16384 if quick else 32768
     qd = jnp.asarray(rng.standard_normal((1, 1, 8, 64)).astype(np.float32)).astype(jnp.bfloat16)
-    kd = jnp.asarray(rng.standard_normal((1, S, 4, 64)).astype(np.float32)).astype(jnp.bfloat16)
-    vd = jnp.asarray(rng.standard_normal((1, S, 4, 64)).astype(np.float32)).astype(jnp.bfloat16)
+    kd = jnp.asarray(rng.standard_normal((1, 4, S, 64)).astype(np.float32)).astype(jnp.bfloat16)
+    vd = jnp.asarray(rng.standard_normal((1, 4, S, 64)).astype(np.float32)).astype(jnp.bfloat16)
     for p in (100, S - 1):
         fo = flash_decode(qd, kd, vd, jnp.int32(p))
         fr = attention_ref(qd, kd, vd, jnp.int32(p))
@@ -104,10 +105,10 @@ def main() -> None:
     Ss = S // 2
     for p, s0 in ((Ss // 2, 0), (Ss // 2, Ss), (S - 1, Ss)):
         acc, m, l = flash_decode_stats(
-            qd, kd[:, :Ss], vd[:, :Ss], jnp.int32(p), jnp.int32(s0)
+            qd, kd[:, :, :Ss], vd[:, :, :Ss], jnp.int32(p), jnp.int32(s0)
         )
         acc_r, m_r, l_r = jnp_stats(
-            qd, kd[:, :Ss], vd[:, :Ss], jnp.int32(p), jnp.int32(s0)
+            qd, kd[:, :, :Ss], vd[:, :, :Ss], jnp.int32(p), jnp.int32(s0)
         )
         lmask = np.asarray(l_r) > 0
         if lmask.any():

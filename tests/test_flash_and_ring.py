@@ -15,8 +15,9 @@ from dllama_tpu.parallel.ring_attention import ring_attention
 def make_qkv(b, t, h, kh, hd, s, seed=0):
     rng = np.random.default_rng(seed)
     q = jnp.asarray(rng.standard_normal((b, t, h, hd)).astype(np.float32))
-    k = jnp.asarray(rng.standard_normal((b, s, kh, hd)).astype(np.float32))
-    v = jnp.asarray(rng.standard_normal((b, s, kh, hd)).astype(np.float32))
+    # head-major cache layout [B, KH, S, hd] (see ops/flash_attention.py)
+    k = jnp.asarray(rng.standard_normal((b, kh, s, hd)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((b, kh, s, hd)).astype(np.float32))
     return q, k, v
 
 

@@ -164,9 +164,9 @@ def test_forward_parked_lane_isolation(tmp_path):
     )
     assert np.isfinite(np.asarray(logits2)[1]).all()
     # parked lane wrote ONLY padding rows: its real cache region is zeros
-    k2 = np.asarray(cache2["k"])  # [L, B, S+pad, KH, hd]
-    assert np.abs(k2[:, 1, :s]).max() == 0.0
-    assert np.abs(k2[:, 1, s : s + 2]).max() > 0.0  # parked writes landed
+    k2 = np.asarray(cache2["k"])  # [L, B, KH, S+pad, hd]
+    assert np.abs(k2[:, 1, :, :s]).max() == 0.0
+    assert np.abs(k2[:, 1, :, s : s + 2]).max() > 0.0  # parked writes landed
 
 
 def test_moe_gather_decode_matches_dense_routing(tmp_path):
