@@ -34,12 +34,7 @@ from jax import lax
 from ..formats.model_file import HiddenAct, LlmArch, LlmHeader, RopeType
 from ..ops.jnp_ops import apply_rope, gelu, qk_rms_norm, rms_norm, silu
 from ..ops.quant_matmul import QuantWeight, dequant, qmatmul_tp
-from ..ops.flash_attention import (
-    flash_attention,
-    flash_decode,
-    pick_decode_block,
-    pick_flash_blocks,
-)
+from ..ops.flash_attention import flash_attention, pick_flash_blocks
 from ..ops.moe_kernel import moe_active_experts, moe_active_experts_q40
 
 Params = Dict[str, Any]
@@ -78,12 +73,20 @@ def _attention_tp(
     head_dim: int,
     mesh,
 ) -> jnp.ndarray:
-    """Attention dispatch on TPU: the flash-decode kernel for T=1 (per-step
-    cache reads bounded by pos via DMA-elided block clamping — the O(pos)
-    property of the reference's decode attention), the prefill flash
-    kernel for T >= 8 (blockwise online softmax, no [T, S] score
-    materialization — the long-context replacement for multiheadAtt_F32),
-    einsum elsewhere.
+    """Attention dispatch on TPU: XLA dense attention for T=1 decode over
+    the (window-sliced) cache, the prefill flash kernel for T >= 8
+    (blockwise online softmax, no [T, S] score materialization — the
+    long-context replacement for multiheadAtt_F32), einsum elsewhere.
+
+    Decode deliberately does NOT use the Pallas flash-decode kernel: the
+    round-3 silicon probe (scripts/decode_probe.py) showed (a) Mosaic does
+    not elide the HBM->VMEM copy when a clamped BlockSpec index repeats,
+    so the kernel reads the WHOLE cache every step regardless of pos, and
+    (b) XLA's own dense T=1 attention is faster on the same cache
+    (0.25 vs 0.40 ms/iter on a 33 MB cache). O(pos) decode reads come
+    from the engine's bucketed attn_window slicing instead — the O(pos)
+    property of the reference's decode attention
+    (src/nn/nn-cpu-ops.cpp:753-788) lives in the window, not the kernel.
 
     Heads are the TP axis (reference: sliceMultiHeadAtt), so the kernels
     run per-shard under shard_map with no collectives.
@@ -98,9 +101,7 @@ def _attention_tp(
         return _attention_sp(q, k_cache, v_cache, pos, head_dim, mesh)
     on_tpu = jax.default_backend() == "tpu"
     s = k_cache.shape[2]
-    if on_tpu and t == 1 and pick_decode_block(s) is not None:
-        kernel = flash_decode  # handles scalar and per-lane pos
-    elif on_tpu and t >= 8 and pick_flash_blocks(t, s) is not None:
+    if on_tpu and t >= 8 and pick_flash_blocks(t, s) is not None:
         kernel = flash_attention  # handles scalar and per-lane pos
     else:
         return _attention(q, k_cache, v_cache, pos, head_dim)
@@ -160,22 +161,15 @@ def _attention_sp(
 
     if t == 1:
         q_spec = P("dp", None, "tp", None)
-        # Pallas local step on TPU: per-shard cache reads bounded by pos
-        # via the clamped DMA schedule (shards in the query's future pay
-        # one skipped-compute block); dense jnp stats elsewhere
-        from ..ops.flash_attention import flash_decode_stats
-
-        use_decode_flash = (
-            jax.default_backend() == "tpu"
-            and pick_decode_block(shard) is not None
-        )
+        # dense jnp stats as the local step: the silicon probe
+        # (scripts/decode_probe.py) showed XLA's dense T=1 attention beats
+        # the Pallas decode kernel and that the kernel's pos-clamped DMA
+        # schedule does not actually elide copies on Mosaic — so the
+        # Pallas local step (flash_decode_stats) buys nothing here
 
         def body(qq, kk, vv, pp):
             idx = lax.axis_index("sp")
-            if use_decode_flash:
-                acc, m, l = flash_decode_stats(qq, kk, vv, pp, idx * shard)
-            else:
-                acc, m, l = attention_stats(qq, kk, vv, pp, idx * shard)
+            acc, m, l = attention_stats(qq, kk, vv, pp, idx * shard)
             m_g = lax.pmax(m, "sp")
             scale = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - m_g))
             l_g = lax.psum(l * scale, "sp")

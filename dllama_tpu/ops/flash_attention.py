@@ -14,13 +14,19 @@ Semantics match models/transformer._attention exactly:
 
 Kernel layout: grid (B * H, T blocks, S blocks), S innermost so the online
 softmax state (m, l, acc) lives in VMEM scratch across S steps. S blocks
-entirely above the causal frontier are compute-skipped via pl.when AND
-DMA-skipped via a clamped kv index map (a repeated block index elides the
-HBM->VMEM copy). The cache is HEAD-MAJOR [B, KH, S, hd]: each grid step's
-kv tile is a (block_s, hd) plane of one head, which satisfies Mosaic's
-last-two-dims tiling rule for any head_dim (a [B, S, KH, hd] layout would
-need an illegal size-1 head block inside the last two dims — rejected on
-real silicon) and avoids (KH, hd) -> (8, 128) tile padding in HBM.
+entirely above the causal frontier are compute-skipped via pl.when, and
+their kv index map is clamped to the causal frontier. NOTE (round-3
+silicon finding, scripts/decode_probe.py): Mosaic does NOT elide the
+HBM->VMEM copy when a block index repeats, so the clamp bounds COMPUTE
+but not DMA traffic — per-call cache reads are O(S), which is why the
+engine bounds decode reads with bucketed attn_window slicing instead and
+uses these kernels only where blockwise softmax itself is the win
+(prefill's [T, S] score materialization). The cache is HEAD-MAJOR
+[B, KH, S, hd]: each grid step's kv tile is a (block_s, hd) plane of one
+head, which satisfies Mosaic's last-two-dims tiling rule for any head_dim
+(a [B, S, KH, hd] layout would need an illegal size-1 head block inside
+the last two dims — rejected on real silicon) and avoids
+(KH, hd) -> (8, 128) tile padding in HBM.
 """
 
 from __future__ import annotations
@@ -189,9 +195,9 @@ def flash_attention_stats(
         return (bh, ti, 0)
 
     def kv_map(bh, ti, si, pos_ref, spos_ref):
-        # clamp past the causal frontier of this query tile: revisiting a
-        # block index elides the DMA, so fully-masked tiles (and cache rows
-        # beyond pos in chunked prefill) cost no HBM traffic
+        # clamp past the causal frontier of this query tile (fully-masked
+        # tiles re-fetch the frontier block: compute is skipped but Mosaic
+        # does not elide the repeated-index DMA — see module docstring)
         limit = jnp.maximum(
             (pos_ref[bh // h] + (ti + 1) * block_t - 1 - spos_ref[0])
             // block_s,
@@ -256,19 +262,17 @@ def _flash_decode_kernel(
     scale: float,
     emit_stats: bool,
 ):
-    """T=1 decode step: one query token per lane group, online softmax over
-    S blocks. Blocks entirely beyond `pos` are compute-skipped here AND
-    DMA-skipped by the clamped kv index map (`pl.pallas_call` elides the
-    HBM->VMEM copy when the block index repeats), so per-step cache reads
-    are proportional to pos — the O(pos) property of the reference's
-    decode attention (src/nn/nn-cpu-ops.cpp:753-788) — while the compiled
-    program covers the whole cache (no per-window recompiles). Positions
-    are per LANE (pos_ref[b]), so independent decode lanes at different
-    depths each read only their own ~pos rows. With `emit_stats` the
-    kernel emits the UNNORMALIZED (acc, m, l) partial state relative to a
-    KV shard starting at absolute position spos_ref[0] — the sp-sharded
-    decode's local step (models/transformer._attention_sp merges these
-    across shards)."""
+    """T=1 decode step: one query token per lane group, online softmax
+    over S blocks. Blocks entirely beyond `pos` are compute-skipped and
+    their kv index clamps to pos's block — but on real Mosaic the
+    repeated-index DMA is NOT elided (scripts/decode_probe.py), so cache
+    reads stay O(S) per call and the ENGINE does not use this kernel for
+    decode anymore (windowed XLA dense attention measured faster there);
+    it is kept as the op-level T=1 flash surface and for stats emission.
+    Positions are per LANE (pos_ref[b]). With `emit_stats` the kernel
+    emits the UNNORMALIZED (acc, m, l) partial state relative to a KV
+    shard starting at absolute position spos_ref[0] (the contract
+    models/transformer._attention_sp's merge consumes)."""
     if emit_stats:
         acc_out, m_out, l_out, m_ref, l_ref, acc_ref = rest
     else:
@@ -362,11 +366,12 @@ def _flash_decode_impl(
 
     The G = H/KH query heads of each KV group ride the sublane dim (one
     [G, hd] x [hd, block_s] matmul per KV block), and the kv BlockSpec
-    index map clamps at pos's block so the pipeline only moves ~pos rows
-    of cache per step regardless of allocated seq_len. The cache is
-    consumed in its storage layout [B, KH, S, hd] via 4-D BlockSpecs — no
-    per-step copy/transpose of the cache is ever materialized, and each
-    tile is a Mosaic-legal (block_s, hd) plane.
+    index map clamps at pos's block (compute skip only — the repeated
+    -index DMA is not elided on Mosaic, so reads are O(S) per call; see
+    module docstring). The cache is consumed in its storage layout
+    [B, KH, S, hd] via 4-D BlockSpecs — no per-step copy/transpose of the
+    cache is ever materialized, and each tile is a Mosaic-legal
+    (block_s, hd) plane.
     """
     b, t, h, hd = q.shape
     assert t == 1, "flash_decode is the T=1 path"
@@ -396,8 +401,8 @@ def _flash_decode_impl(
         return (bk, 0, 0)
 
     def kv_map(bk, si, pos_ref, spos_ref):
-        # clamp: revisiting the same block index elides the DMA, so blocks
-        # beyond this lane's pos cost no HBM traffic
+        # clamp to pos's block (fully-masked steps re-fetch that block;
+        # compute is skipped but the DMA is not elided — see module note)
         limit = jnp.maximum(pos_ref[bk // kh] - spos_ref[0], 0)
         return (bk // kh, bk % kh, jnp.minimum(si, limit // block_s), 0)
 
@@ -483,13 +488,12 @@ def flash_decode_stats(
     block_s: int = 0,
     interpret: bool = False,
 ):
-    """Unnormalized (acc, m, l) decode partial state over a KV shard —
-    the Pallas local step for sp-sharded decode (the shard_map body in
-    models/transformer._attention_sp merges these with a log-sum-exp
-    pmax/psum). Shards entirely in the query's future emit fully-masked
-    stats (m = -inf, l = 0); their DMA floor is ONE block per KV head
-    (the clamp pins the index at block 0, whose copy still happens —
-    compute is skipped), everything beyond that is elided."""
+    """Unnormalized (acc, m, l) decode partial state over a KV shard in
+    the attention_stats contract (log-sum-exp mergeable). Shards entirely
+    in the query's future emit fully-masked stats (m = -inf, l = 0) with
+    all compute skipped. No longer the engine's sp local step (the dense
+    jnp stats won on silicon; see _attention_sp) — kept as the op-level
+    stats surface and covered by tests/test_flash_and_ring.py."""
     return _flash_decode_impl(
         q, k_cache, v_cache, pos, jnp.asarray(s_pos0, jnp.int32),
         block_s=block_s, interpret=interpret, emit_stats=True,

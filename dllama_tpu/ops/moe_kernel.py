@@ -42,9 +42,9 @@ from jax.experimental.pallas import tpu as pltpu
 Q_BLOCK = 32
 
 
-def _swiglu_accum(x, w1, w3, w2, routing_w, ki, n_k, acc_ref, o_ref):
+def _swiglu_accum(x, w1, w3, w2, routing_w, ti, ki, n_k, acc_ref, o_ref):
     """Shared kernel tail: SwiGLU through one expert's weights, weighted
-    accumulation in VMEM scratch, emit on the last active expert."""
+    accumulation in VMEM scratch, row emit on the last active expert."""
 
     @pl.when(ki == 0)
     def _init():
@@ -66,25 +66,30 @@ def _swiglu_accum(x, w1, w3, w2, routing_w, ki, n_k, acc_ref, o_ref):
 
     @pl.when(ki == n_k - 1)
     def _emit():
-        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+        o_ref[pl.ds(ti, 1), :] = acc_ref[:].astype(o_ref.dtype)
 
 
 def _moe_kernel(
     idx_ref,  # scalar prefetch: [m, k] int32 expert ids
     w_ref,  # scalar prefetch: [m, k] f32 routing weights (SMEM)
-    x_ref,  # [1, D] (this token's row)
+    x_ref,  # [m, D] f32 (ALL token rows; whole-array block)
     w1_ref,  # [1, D, F] (selected expert)
     w3_ref,  # [1, D, F]
     w2_ref,  # [1, F, D]
-    o_ref,  # [1, D]
+    o_ref,  # [m, D] (whole-array block, one row written per token)
     acc_ref,  # VMEM [1, D] f32
     *,
     n_k: int,
 ):
     ti, ki = pl.program_id(0), pl.program_id(1)
+    # dynamic sublane row: this token. x rides in f32 — an (8, 128)-tiled
+    # dtype, so any row index is aligned; a bf16 x packs two rows per
+    # sublane word and Mosaic demands the index be provably even. Compute
+    # happens in the weights' dtype.
+    x = x_ref[pl.ds(ti, 1), :].astype(w1_ref.dtype)
     _swiglu_accum(
-        x_ref[:], w1_ref[0], w3_ref[0], w2_ref[0],
-        w_ref[ti, ki], ki, n_k, acc_ref, o_ref,
+        x, w1_ref[0], w3_ref[0], w2_ref[0],
+        w_ref[ti, ki], ti, ki, n_k, acc_ref, o_ref,
     )
 
 
@@ -102,14 +107,14 @@ def _dequant_block(q, d):
 def _moe_kernel_q40(
     idx_ref,  # scalar prefetch: [m, k] int32 expert ids
     w_ref,  # scalar prefetch: [m, k] f32 routing weights
-    x_ref,  # [1, D]
+    x_ref,  # [m, D] f32 (whole-array block)
     w1q_ref,  # [1, D, F] int8
     w1d_ref,  # [1, D // 32, F] f32
     w3q_ref,  # [1, D, F] int8
     w3d_ref,  # [1, D // 32, F] f32
     w2q_ref,  # [1, F, D] int8
     w2d_ref,  # [1, F // 32, D] f32
-    o_ref,  # [1, D]
+    o_ref,  # [m, D] (whole-array block)
     acc_ref,  # VMEM [1, D] f32
     *,
     n_k: int,
@@ -118,13 +123,17 @@ def _moe_kernel_q40(
     w1 = _dequant_block(w1q_ref[0], w1d_ref[0])
     w3 = _dequant_block(w3q_ref[0], w3d_ref[0])
     w2 = _dequant_block(w2q_ref[0], w2d_ref[0])
-    _swiglu_accum(
-        x_ref[:], w1, w3, w2, w_ref[ti, ki], ki, n_k, acc_ref, o_ref
-    )
+    x = x_ref[pl.ds(ti, 1), :].astype(jnp.bfloat16)  # f32 in: row-aligned
+    _swiglu_accum(x, w1, w3, w2, w_ref[ti, ki], ti, ki, n_k, acc_ref, o_ref)
 
 
-def _row_map(ti, ki, idx_ref, w_ref):
-    return (ti, 0)
+def _full_map(ti, ki, idx_ref, w_ref):
+    # x and out ride as ONE whole-array block: a per-token (1, D) block
+    # would put a size-1 dim in the last-two block dims, which Mosaic
+    # rejects for m > 1 (the same tiling rule that forced the head-major
+    # KV layout); rows are selected inside the kernel by dynamic sublane
+    # slice instead. m is decode-lane sized, so the resident tile is tiny.
+    return (0, 0)
 
 
 def _sel_map(ti, ki, idx_ref, w_ref):
@@ -153,17 +162,17 @@ def moe_active_experts(
             num_scalar_prefetch=2,
             grid=(m, k),
             in_specs=[
-                pl.BlockSpec((1, d), _row_map),
+                pl.BlockSpec((m, d), _full_map),
                 pl.BlockSpec((1, d, f), _sel_map),
                 pl.BlockSpec((1, d, f), _sel_map),
                 pl.BlockSpec((1, f, d), _sel_map),
             ],
-            out_specs=pl.BlockSpec((1, d), _row_map),
+            out_specs=pl.BlockSpec((m, d), _full_map),
             scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((m, d), jnp.float32),
         interpret=interpret,
-    )(top_i, weights.astype(jnp.float32), x, w1, w3, w2)
+    )(top_i, weights.astype(jnp.float32), x.astype(jnp.float32), w1, w3, w2)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -193,7 +202,7 @@ def moe_active_experts_q40(
             num_scalar_prefetch=2,
             grid=(m, k),
             in_specs=[
-                pl.BlockSpec((1, d), _row_map),
+                pl.BlockSpec((m, d), _full_map),
                 pl.BlockSpec((1, d, f), _sel_map),
                 pl.BlockSpec((1, d // Q_BLOCK, f), _sel_map),
                 pl.BlockSpec((1, d, f), _sel_map),
@@ -201,12 +210,12 @@ def moe_active_experts_q40(
                 pl.BlockSpec((1, f, d), _sel_map),
                 pl.BlockSpec((1, f // Q_BLOCK, d), _sel_map),
             ],
-            out_specs=pl.BlockSpec((1, d), _row_map),
+            out_specs=pl.BlockSpec((m, d), _full_map),
             scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((m, d), jnp.float32),
         interpret=interpret,
     )(
         top_i, weights.astype(jnp.float32),
-        x.astype(jnp.bfloat16), w1q, w1d, w3q, w3d, w2q, w2d,
+        x.astype(jnp.float32), w1q, w1d, w3q, w3d, w2q, w2d,
     )

@@ -251,29 +251,22 @@ class InferenceEngine:
             w *= 2
         # NB: crossing a window boundary mid-generation compiles a fresh
         # program for the next window (one synchronous stall per crossing,
-        # log2(seq_len/512) of them worst case). This only applies to
-        # prefill and the CPU decode path: TPU decode uses the flash-decode
-        # kernel whose cache reads are pos-bounded inside ONE full-length
-        # program (`_decode_window`), so no decode recompiles happen.
+        # log2(seq_len/512) of them worst case, amortized by the on-disk
+        # compilation cache across runs).
         return min(w, s)
 
     def _decode_window(self, limit: int) -> int:
-        """Window for T=1 decode programs. On TPU the flash-decode kernel
-        bounds per-step cache reads by pos via its clamped DMA schedule, so
-        a single full-cache program (window 0) serves every position with
-        no window-crossing recompile stalls; elsewhere fall back to the
-        bucketed windows."""
-        from ..ops.flash_attention import pick_decode_block
-
+        """Window for T=1 decode programs: the bucketed power-of-2 window
+        on every backend. (Round-3 silicon falsified the flash-decode
+        alternative: Mosaic does not elide repeated-index DMAs, so a
+        full-cache Pallas program reads all S rows at every step — the
+        windowed XLA dense program reads ~2*pos instead and is faster per
+        row; see scripts/decode_probe.py. One compiled program per window,
+        log2(seq_len/512) worst case, amortized by the compilation
+        cache.)"""
         if self.sp > 1:
             # full sharded cache view: each sp shard scores its 1/sp of
             # the rows (dense, masked) and merges stats — see _attn_window
-            return 0
-        if (
-            jax.default_backend() == "tpu"
-            and pick_decode_block(self.header.seq_len + self._lane_pad)
-            is not None
-        ):
             return 0
         return self._attn_window(limit)
 
@@ -578,14 +571,16 @@ class InferenceEngine:
             self.cache = step(self.params, arr, self.cache, pos_arr)
             p += width
 
-    def _lane_decode_fn(self, n_steps: int):
+    def _lane_decode_fn(self, n_steps: int, window: int = 0):
         """Per-lane block decode: every lane advances from its own
         position; inactive lanes are parked (fed token 0, writing only
         padding rows). Sampling settings are per-lane vectors (temperature
         0 = greedy argmax inside _sample_on_device), so ONE compiled
         program serves any mix of requests. One host dispatch per block,
-        like decode_block."""
-        key = ("lane_block", n_steps)
+        like decode_block. `window` bounds attention reads by the deepest
+        live lane (parked writes land beyond seq_len and are causally
+        masked, so the window only limits reads)."""
+        key = ("lane_block", n_steps, window)
         if key in self._compiled:
             return self._compiled[key]
         h = self.header
@@ -606,6 +601,7 @@ class InferenceEngine:
                 with ctx:
                     logits, cache = forward(
                         params, h, tok, cur, cache, mesh=mesh,
+                        attn_window=window,
                         attn_park_threshold=park, logits_mode="last",
                     )
                 last = logits[:, -1, :]
@@ -661,7 +657,8 @@ class InferenceEngine:
         )
         pos_arr = jnp.asarray(pos, jnp.int32)
         act_arr = jnp.asarray(active, jnp.bool_)
-        block = self._lane_decode_fn(n_steps)
+        window = self._attn_window(max(pos[i] for i in live) + n_steps)
+        block = self._lane_decode_fn(n_steps, window)
         self._rng_calls += 1
         rng = jax.random.fold_in(
             jax.random.fold_in(self._base_key, max(pos)), self._rng_calls

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Decode-attention design probe — run on real TPU silicon.
+
+Measurement method (the only one that survives this platform): the axon
+tunnel adds a large, jittery fixed cost per dispatched program AND per
+host readback (tens of ms round trip), so neither single-call timing nor
+a single fori_loop average is meaningful. Each variant therefore runs as
+ONE jitted lax.fori_loop at TWO iteration counts (N_LO, N_HI) and reports
+the MARGINAL per-iteration time (t_hi - t_lo) / (N_HI - N_LO), min over
+several reps — fixed dispatch/readback costs cancel in the difference.
+
+Questions:
+  A. does the flash-decode clamped index map bound cache reads by pos on
+     real Mosaic (pos=511 vs pos=S-1, same program)?
+  B. XLA dense T=1 attention on the same cache.
+  C. windowed dense / flash (what bucketed decode costs at small pos).
+  D. raw HBM read-rate reference (sum-reduce the cache).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from dllama_tpu.parallel.mesh import enable_compilation_cache, reassert_platform
+
+reassert_platform()
+enable_compilation_cache()
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+N_LO, N_HI = 8, 128
+
+
+def sync(x):
+    return np.asarray(jax.device_get(jnp.ravel(x)[0]))
+
+
+def marginal_ms(body, n_outer=6):
+    """Per-iteration device ms of `body(i) -> array`, by differencing two
+    on-device loop lengths (fixed tunnel costs cancel)."""
+
+    def make(n):
+        @jax.jit
+        def run():
+            def step(i, acc):
+                return acc + body(i).astype(jnp.float32).sum()
+
+            return lax.fori_loop(0, n, step, jnp.float32(0.0))
+
+        return run
+
+    f_lo, f_hi = make(N_LO), make(N_HI)
+    sync(f_lo())
+    sync(f_hi())
+    best_lo = best_hi = float("inf")
+    for _ in range(n_outer):
+        t0 = time.perf_counter()
+        sync(f_lo())
+        best_lo = min(best_lo, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        sync(f_hi())
+        best_hi = min(best_hi, time.perf_counter() - t0)
+    return (best_hi - best_lo) / (N_HI - N_LO) * 1000
+
+
+def report(name, ms, mbytes):
+    print(f"{name:34s} {ms:8.4f} ms/iter  {mbytes / ms:7.1f} GB/s eff",
+          flush=True)
+
+
+def main():
+    from dllama_tpu.ops.flash_attention import flash_decode
+    from dllama_tpu.ops.jnp_ops import attention_dense
+
+    rng = np.random.default_rng(0)
+    B, H, KH, HD = 1, 8, 4, 64
+    S = 32768
+    q = jnp.asarray(rng.standard_normal((B, 1, H, HD)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, KH, S, HD)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, KH, S, HD)), jnp.bfloat16)
+    cache_mb = 2 * B * KH * S * HD * 2 / 1e6
+    print(f"cache bytes (k+v): {cache_mb:.1f} MB; marginal over "
+          f"N={N_LO}->{N_HI} on-device iters", flush=True)
+
+    # D: raw read-rate reference
+    ms = marginal_ms(lambda i: (k + i).astype(jnp.float32).sum()[None])
+    report(f"D sum-reduce k ({cache_mb/2:.0f} MB)", ms, cache_mb / 2)
+
+    # A: flash decode, pos-bounded?
+    for pos, bs in ((511, 1024), (S - 1, 1024)):
+        try:
+            ms = marginal_ms(
+                lambda i, pos=pos, bs=bs: flash_decode(
+                    q, k, v, jnp.int32(pos) + 0 * i, block_s=bs)
+            )
+            report(f"A flash pos={pos} bs={bs}", ms, cache_mb)
+        except Exception as e:
+            print(f"A flash pos={pos} bs={bs}: {type(e).__name__}: "
+                  f"{str(e)[:100]}", flush=True)
+
+    # B: XLA dense full cache
+    ms = marginal_ms(lambda i: attention_dense(q, k, v, jnp.int32(S - 1) + 0 * i))
+    report(f"B xla-dense S={S}", ms, cache_mb)
+
+    # C: windowed dense / flash at small pos
+    for w in (512, 2048, 8192):
+        kw, vw = k[:, :, :w], v[:, :, :w]
+        mb = 2 * B * KH * w * HD * 2 / 1e6
+        ms = marginal_ms(
+            lambda i, kw=kw, vw=vw, w=w: attention_dense(
+                q, kw, vw, jnp.int32(w - 1) + 0 * i)
+        )
+        report(f"C xla-dense window={w}", ms, mb)
+    for w in (2048, 8192):
+        kw, vw = k[:, :, :w], v[:, :, :w]
+        mb = 2 * B * KH * w * HD * 2 / 1e6
+        try:
+            ms = marginal_ms(
+                lambda i, kw=kw, vw=vw, w=w: flash_decode(
+                    q, kw, vw, jnp.int32(w - 1) + 0 * i, block_s=1024)
+            )
+            report(f"C2 flash window={w}", ms, mb)
+        except Exception as e:
+            print(f"C2 flash window={w}: {type(e).__name__}: {str(e)[:100]}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -124,15 +124,16 @@ def main() -> None:
             f"{err:.2e} {'OK' if err < 2e-2 else 'FAIL'}",
         )
 
+    # NOTE: the round-3 silicon probe (scripts/decode_probe.py) showed
+    # Mosaic does NOT elide repeated-index DMAs, so flash decode reads the
+    # whole cache regardless of pos and the ENGINE now decodes via
+    # windowed XLA dense attention instead. The ratio below is recorded
+    # informationally (expected ~1.0), not gated.
     t_low = timeit(lambda: flash_decode(qd, kd, vd, jnp.int32(512)))
     t_high = timeit(lambda: flash_decode(qd, kd, vd, jnp.int32(S - 1)))
-    # clamped DMA schedule => decode at pos=512 must be much cheaper than
-    # at pos=S-1 even though both run the same full-cache program
-    ratio = t_high / max(t_low, 1e-9)
     record(
-        "flash decode pos-bounded reads",
-        f"pos512 {t_low:.3f} ms vs pos{S-1} {t_high:.3f} ms "
-        f"(x{ratio:.1f}) {'OK' if ratio > 4 else 'FAIL (reads not pos-bounded)'}",
+        "flash decode pos512/posS-1 (info)",
+        f"{t_low:.3f} ms vs {t_high:.3f} ms (x{t_high / max(t_low, 1e-9):.1f})",
     )
 
     # 3. ragged MoE kernel on silicon + timing vs dense
@@ -142,19 +143,25 @@ def main() -> None:
     w1 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.05).astype(jnp.bfloat16)
     w2 = jnp.asarray(rng.standard_normal((E, F, D)).astype(np.float32) * 0.05).astype(jnp.bfloat16)
     w3 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.05).astype(jnp.bfloat16)
-    xm = jnp.asarray(rng.standard_normal((1, D)).astype(np.float32)).astype(jnp.bfloat16)
-    idx = jnp.asarray(rng.choice(E, K, replace=False).astype(np.int32))[None, :]
-    wts = jnp.asarray(np.full((1, K), 1.0 / K, np.float32))
+    M = 4  # multi-lane decode: exercises the dynamic sublane row select
+    xm = jnp.asarray(rng.standard_normal((M, D)).astype(np.float32)).astype(jnp.bfloat16)
+    idx = jnp.asarray(
+        np.stack([rng.choice(E, K, replace=False) for _ in range(M)]).astype(np.int32)
+    )
+    wts = jnp.asarray(np.full((M, K), 1.0 / K, np.float32))
     out = moe_active_experts(xm, w1, w2, w3, idx, wts)
     # numpy oracle
     xf = np.asarray(xm, np.float32)
-    exp = np.zeros((1, D), np.float32)
-    for i, e in enumerate(np.asarray(idx)[0]):
-        h1 = xf @ np.asarray(w1[e], np.float32)
-        h3 = xf @ np.asarray(w3[e], np.float32)
-        exp += float(wts[0, i]) * ((h1 / (1 + np.exp(-h1)) * h3) @ np.asarray(w2[e], np.float32))
+    exp = np.zeros((M, D), np.float32)
+    for t_i in range(M):
+        for i, e in enumerate(np.asarray(idx)[t_i]):
+            h1 = xf[t_i : t_i + 1] @ np.asarray(w1[e], np.float32)
+            h3 = xf[t_i : t_i + 1] @ np.asarray(w3[e], np.float32)
+            exp[t_i] += float(wts[t_i, i]) * (
+                (h1 / (1 + np.exp(-h1)) * h3) @ np.asarray(w2[e], np.float32)
+            )[0]
     rel = float(np.abs(np.asarray(out) - exp).max() / (np.abs(exp).max() + 1e-9))
-    record("ragged moe rel err", f"{rel:.2e} {'OK' if rel < 5e-2 else 'FAIL'}")
+    record(f"ragged moe rel err (m={M})", f"{rel:.2e} {'OK' if rel < 5e-2 else 'FAIL'}")
 
     # 3b. quantized ragged MoE kernel on silicon
     from dllama_tpu.ops.moe_kernel import moe_active_experts_q40
