@@ -263,6 +263,15 @@ def run_chat(args) -> None:
         chat = gen.generate([ChatItem("user", user)], append_generation_prompt=True)
         tokens = tok.encode(chat.content, is_start=is_start, add_special_tokens=True)
         is_start = False
+        # Context exhaustion: stop explicitly instead of silently generating
+        # zero tokens forever (the reference prints an explicit stop when the
+        # window fills, src/dllama.cpp:242-253).
+        if pos + len(tokens) >= engine.header.seq_len:
+            print(
+                f"\n🚫 Context window full ({engine.header.seq_len} tokens); "
+                "restart the chat to continue."
+            )
+            break
         detector = EosDetector(
             tok.eos_token_ids, stops, padding_left=2, padding_right=2
         )

@@ -14,12 +14,15 @@ which expert's weight tile to copy HBM->VMEM per grid step — the selected
 expert weights are read exactly once per (token, choice), nothing else
 moves.
 
-Grid: (m, k) — token-major, active experts innermost; one SwiGLU expert
-pipeline per step, accumulated into a VMEM scratch row weighted by the
-routing probability. Routing is PER TOKEN (each decode lane picks its own
-top-k, matching the reference's per-row indexes buffer). Decode-sized
-m (the engine's dp lanes); prefill keeps the dense path where every
-expert is busy anyway.
+Grid: (m, k, F blocks) — token-major, active experts next, the expert's
+hidden (F) dim innermost. F-blocking is exact (SwiGLU is elementwise in F
+and w2 contracts over it) and is what keeps full-scale experts (e.g. A3B:
+D=2048, F=768 -> 9 MB of bf16 tiles per step unblocked) inside the 16 MB
+scoped-VMEM budget with double buffering — the unblocked version was
+rejected by the real compiler at exactly that shape. Routing is PER TOKEN
+(each decode lane picks its own top-k, matching the reference's per-row
+indexes buffer). Decode-sized m (the engine's dp lanes); prefill keeps the
+dense path where every expert is busy anyway.
 
 Two variants:
 - `moe_active_experts`: dense bf16/f32 expert weights.
@@ -41,30 +44,62 @@ from jax.experimental.pallas import tpu as pltpu
 
 Q_BLOCK = 32
 
+# Per-step VMEM budget for the three expert tiles (double-buffered by the
+# pipeline; the 16 MB scoped-vmem ceiling also holds dequant temporaries).
+_TILE_BUDGET_BYTES = 8_000_000
 
-def _swiglu_accum(x, w1, w3, w2, routing_w, ti, ki, n_k, acc_ref, o_ref):
-    """Shared kernel tail: SwiGLU through one expert's weights, weighted
-    accumulation in VMEM scratch, row emit on the last active expert."""
 
-    @pl.when(ki == 0)
+def _pick_f_block(f: int, d: int, quantized: bool, itemsize: int = 2) -> int:
+    """Largest F block that divides f, satisfies Mosaic tiling for every
+    operand, and fits the VMEM budget.
+
+    The q40 variant's w2 scale tensor [E, F // 32, D] blocks its sublane
+    dim at bf // 32, which Mosaic requires to be a multiple of 8 (or the
+    full extent) — so quantized blocks must be multiples of 256; dense
+    blocks multiples of 128. Falls back to whole-F (no blocking) when no
+    multiple divides f — small test shapes take that path. `itemsize` is
+    the dense weights' actual bytes/elem (the loader materializes f32/f16
+    wire weights as float32, i.e. 4, not bf16's 2)."""
+    # effective bytes/elem across the three tiles incl. in-kernel dequant
+    # temporaries (q40: int8 + f32/32 scales + a bf16 dequant copy)
+    bpe = 3.2 if quantized else float(itemsize)
+    step = 256 if quantized else 128
+    budget_bf = int(_TILE_BUDGET_BYTES / (2 * 3 * d * bpe))
+    best = 0
+    b = step
+    while b <= min(f, max(budget_bf, step)):
+        if f % b == 0:
+            best = b
+        b += step
+    return best or f
+
+
+def _swiglu_accum(x, w1_f, w3_f, w2_f, routing_w, ti, ki, fi, n_k, n_f,
+                  acc_ref, o_ref):
+    """Shared kernel tail: one F-block of SwiGLU through one expert's
+    weights, weighted accumulation in VMEM scratch, row emit on the last
+    (expert, F-block) step. Exact under F-blocking: silu(x@w1)*(x@w3) is
+    elementwise in F and the w2 product sums over F."""
+
+    @pl.when((ki == 0) & (fi == 0))
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     h1 = jax.lax.dot_general(
-        x, w1, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        x, w1_f, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
     h3 = jax.lax.dot_general(
-        x, w3, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        x, w3_f, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
     hidden = (h1 / (1.0 + jnp.exp(-h1))) * h3  # silu(w1 x) * (w3 x), f32
     out = jax.lax.dot_general(
-        hidden.astype(x.dtype), w2,
+        hidden.astype(x.dtype), w2_f,
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     acc_ref[:] += out * routing_w
 
-    @pl.when(ki == n_k - 1)
+    @pl.when((ki == n_k - 1) & (fi == n_f - 1))
     def _emit():
         o_ref[pl.ds(ti, 1), :] = acc_ref[:].astype(o_ref.dtype)
 
@@ -73,15 +108,16 @@ def _moe_kernel(
     idx_ref,  # scalar prefetch: [m, k] int32 expert ids
     w_ref,  # scalar prefetch: [m, k] f32 routing weights (SMEM)
     x_ref,  # [m, D] f32 (ALL token rows; whole-array block)
-    w1_ref,  # [1, D, F] (selected expert)
-    w3_ref,  # [1, D, F]
-    w2_ref,  # [1, F, D]
+    w1_ref,  # [1, D, bf] (selected expert, F block)
+    w3_ref,  # [1, D, bf]
+    w2_ref,  # [1, bf, D]
     o_ref,  # [m, D] (whole-array block, one row written per token)
     acc_ref,  # VMEM [1, D] f32
     *,
     n_k: int,
+    n_f: int,
 ):
-    ti, ki = pl.program_id(0), pl.program_id(1)
+    ti, ki, fi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     # dynamic sublane row: this token. x rides in f32 — an (8, 128)-tiled
     # dtype, so any row index is aligned; a bf16 x packs two rows per
     # sublane word and Mosaic demands the index be provably even. Compute
@@ -89,7 +125,7 @@ def _moe_kernel(
     x = x_ref[pl.ds(ti, 1), :].astype(w1_ref.dtype)
     _swiglu_accum(
         x, w1_ref[0], w3_ref[0], w2_ref[0],
-        w_ref[ti, ki], ti, ki, n_k, acc_ref, o_ref,
+        w_ref[ti, ki], ti, ki, fi, n_k, n_f, acc_ref, o_ref,
     )
 
 
@@ -108,26 +144,29 @@ def _moe_kernel_q40(
     idx_ref,  # scalar prefetch: [m, k] int32 expert ids
     w_ref,  # scalar prefetch: [m, k] f32 routing weights
     x_ref,  # [m, D] f32 (whole-array block)
-    w1q_ref,  # [1, D, F] int8
-    w1d_ref,  # [1, D // 32, F] f32
-    w3q_ref,  # [1, D, F] int8
-    w3d_ref,  # [1, D // 32, F] f32
-    w2q_ref,  # [1, F, D] int8
-    w2d_ref,  # [1, F // 32, D] f32
+    w1q_ref,  # [1, D, bf] int8
+    w1d_ref,  # [1, D // 32, bf] f32
+    w3q_ref,  # [1, D, bf] int8
+    w3d_ref,  # [1, D // 32, bf] f32
+    w2q_ref,  # [1, bf, D] int8
+    w2d_ref,  # [1, bf // 32, D] f32
     o_ref,  # [m, D] (whole-array block)
     acc_ref,  # VMEM [1, D] f32
     *,
     n_k: int,
+    n_f: int,
 ):
-    ti, ki = pl.program_id(0), pl.program_id(1)
+    ti, ki, fi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     w1 = _dequant_block(w1q_ref[0], w1d_ref[0])
     w3 = _dequant_block(w3q_ref[0], w3d_ref[0])
     w2 = _dequant_block(w2q_ref[0], w2d_ref[0])
     x = x_ref[pl.ds(ti, 1), :].astype(jnp.bfloat16)  # f32 in: row-aligned
-    _swiglu_accum(x, w1, w3, w2, w_ref[ti, ki], ti, ki, n_k, acc_ref, o_ref)
+    _swiglu_accum(
+        x, w1, w3, w2, w_ref[ti, ki], ti, ki, fi, n_k, n_f, acc_ref, o_ref
+    )
 
 
-def _full_map(ti, ki, idx_ref, w_ref):
+def _full_map(ti, ki, fi, idx_ref, w_ref):
     # x and out ride as ONE whole-array block: a per-token (1, D) block
     # would put a size-1 dim in the last-two block dims, which Mosaic
     # rejects for m > 1 (the same tiling rule that forced the head-major
@@ -136,8 +175,14 @@ def _full_map(ti, ki, idx_ref, w_ref):
     return (0, 0)
 
 
-def _sel_map(ti, ki, idx_ref, w_ref):
-    return (idx_ref[ti, ki], 0, 0)
+def _row_sel_map(ti, ki, fi, idx_ref, w_ref):
+    # w1/w3-shaped operands [E, D|D//32, F]: expert by routing, F by block
+    return (idx_ref[ti, ki], 0, fi)
+
+
+def _col_sel_map(ti, ki, fi, idx_ref, w_ref):
+    # w2-shaped operands [E, F|F//32, D]: the F axis is the sublane dim
+    return (idx_ref[ti, ki], fi, 0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -155,17 +200,19 @@ def moe_active_experts(
     e, _, f = w1.shape
     k = top_i.shape[-1]
     assert top_i.shape == (m, k), (top_i.shape, m, k)
+    bf = _pick_f_block(f, d, quantized=False, itemsize=w1.dtype.itemsize)
+    n_f = f // bf
 
     return pl.pallas_call(
-        functools.partial(_moe_kernel, n_k=k),
+        functools.partial(_moe_kernel, n_k=k, n_f=n_f),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(m, k),
+            grid=(m, k, n_f),
             in_specs=[
                 pl.BlockSpec((m, d), _full_map),
-                pl.BlockSpec((1, d, f), _sel_map),
-                pl.BlockSpec((1, d, f), _sel_map),
-                pl.BlockSpec((1, f, d), _sel_map),
+                pl.BlockSpec((1, d, bf), _row_sel_map),
+                pl.BlockSpec((1, d, bf), _row_sel_map),
+                pl.BlockSpec((1, bf, d), _col_sel_map),
             ],
             out_specs=pl.BlockSpec((m, d), _full_map),
             scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
@@ -195,20 +242,22 @@ def moe_active_experts_q40(
     e, _, f = w1q.shape
     k = top_i.shape[-1]
     assert top_i.shape == (m, k), (top_i.shape, m, k)
+    bf = _pick_f_block(f, d, quantized=True)
+    n_f = f // bf
 
     return pl.pallas_call(
-        functools.partial(_moe_kernel_q40, n_k=k),
+        functools.partial(_moe_kernel_q40, n_k=k, n_f=n_f),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(m, k),
+            grid=(m, k, n_f),
             in_specs=[
                 pl.BlockSpec((m, d), _full_map),
-                pl.BlockSpec((1, d, f), _sel_map),
-                pl.BlockSpec((1, d // Q_BLOCK, f), _sel_map),
-                pl.BlockSpec((1, d, f), _sel_map),
-                pl.BlockSpec((1, d // Q_BLOCK, f), _sel_map),
-                pl.BlockSpec((1, f, d), _sel_map),
-                pl.BlockSpec((1, f // Q_BLOCK, d), _sel_map),
+                pl.BlockSpec((1, d, bf), _row_sel_map),
+                pl.BlockSpec((1, d // Q_BLOCK, bf), _row_sel_map),
+                pl.BlockSpec((1, d, bf), _row_sel_map),
+                pl.BlockSpec((1, d // Q_BLOCK, bf), _row_sel_map),
+                pl.BlockSpec((1, bf, d), _col_sel_map),
+                pl.BlockSpec((1, bf // Q_BLOCK, d), _col_sel_map),
             ],
             out_specs=pl.BlockSpec((m, d), _full_map),
             scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],

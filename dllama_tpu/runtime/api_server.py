@@ -663,6 +663,18 @@ def make_handler(state: ApiState):
         def _complete_lanes(self, params: InferenceParams) -> None:
             """Concurrent path: submit to the lane scheduler and relay its
             event stream; many handler threads can sit here at once."""
+            # `seed` cannot be honored here (shared on-device RNG stream
+            # across lanes; see the scheduler note) — tell the client
+            # instead of silently returning non-reproducible output
+            warning = None
+            if params.seed is not None:
+                warning = (
+                    "'seed' is ignored under the concurrent lane scheduler "
+                    "(the on-device RNG stream is shared across lanes); "
+                    "run the server with --batch-size 1 for seeded "
+                    "reproducibility"
+                )
+                print(f"⚠️  {warning}", flush=True)
             job = state.scheduler.submit(params)
             if params.stream:
                 self._sse_headers()
@@ -689,13 +701,12 @@ def make_handler(state: ApiState):
                             finish_reason = payload
                             break
                     if not errored:
+                        final = _chunk_payload(state, None, True, finish_reason)
+                        if warning:
+                            final["warning"] = warning
                         _sse_write(
                             self.wfile,
-                            "data: "
-                            + json.dumps(
-                                _chunk_payload(state, None, True, finish_reason)
-                            )
-                            + "\r\n\r\n",
+                            "data: " + json.dumps(final) + "\r\n\r\n",
                         )
                     _sse_write(self.wfile, "data: [DONE]\r\n\r\n")
                     self.wfile.write(b"0\r\n\r\n")
@@ -714,15 +725,16 @@ def make_handler(state: ApiState):
                 if kind == "done":
                     finish_reason = payload
                     break
-            self._json(
-                _completion_response(
-                    state,
-                    job.buffer,
-                    finish_reason,
-                    job.n_prompt_tokens,
-                    job.n_completion,
-                )
+            response = _completion_response(
+                state,
+                job.buffer,
+                finish_reason,
+                job.n_prompt_tokens,
+                job.n_completion,
             )
+            if warning:
+                response["warning"] = warning
+            self._json(response)
 
         def _sse_headers(self) -> None:
             self.send_response(200)

@@ -40,34 +40,44 @@ def _topp_mask(probs, topp):
     """Top-p nucleus mask on device, [B, V] probs -> masked probs; `topp`
     is a scalar or a per-lane [B] vector.
 
-    Same selection rule as the host sampler (keep the smallest prefix of
-    descending probs whose cumulative mass exceeds topp, including the
-    crossing token — reference: sample_topp, tokenizer.cpp:426-467);
-    topp outside (0, 1) keeps the full distribution, matching the host
-    sampler's sample_mult fallthrough, and a cumsum that never crosses
-    (f32 rounding at topp near 1) keeps everything, matching the host's
-    empty-`over` branch. Split out so its support set can be
-    equivalence-tested against the host rule (tests/test_engine.py).
+    Same selection rule as the host sampler (apply the cutoff pre-filter
+    (1 - topp) / (V - 1), then keep the smallest prefix of descending
+    probs whose cumulative mass exceeds topp, including the crossing
+    token — reference: sample_topp, tokenizer.cpp:426-467); topp outside
+    (0, 1) keeps the full distribution, matching the host sampler's
+    sample_mult fallthrough, and a cumsum that never crosses (f32
+    rounding at topp near 1) keeps the cutoff-filtered set, matching the
+    host's empty-`over` branch (which also samples from the filtered
+    set). Split out so its support set can be equivalence-tested against
+    the host rule (tests/test_engine.py).
     Known divergence: exact prob TIES at the nucleus boundary keep all
     tied tokens here (threshold rule) where the host keeps only those
     before its sort's crossing point — the host's own tie order is
     sort-dependent, so the boundary choice is arbitrary in both.
     """
-    b = probs.shape[0]
+    b, v = probs.shape
     topp_col = jnp.broadcast_to(
         jnp.atleast_1d(jnp.asarray(topp, jnp.float32)), (b,)
     )[:, None]
-    sorted_probs = jnp.sort(probs, axis=-1)[..., ::-1]
+    topp_valid = jnp.logical_and(topp_col > 0.0, topp_col < 1.0)
+    # host sampler pre-filter: rows below (1-topp)/(V-1) can never be part
+    # of a nucleus that still needs them; the host drops them before its
+    # sort and KEEPS ONLY the filtered set in the never-crosses fallback
+    cutoff = (1.0 - topp_col) / jnp.float32(v - 1)
+    pf = jnp.where(jnp.logical_and(topp_valid, probs < cutoff), 0.0, probs)
+    sorted_probs = jnp.sort(pf, axis=-1)[..., ::-1]
     csum = jnp.cumsum(sorted_probs, axis=-1)
     crossed = csum > topp_col
     cross = jnp.where(
         jnp.any(crossed, axis=-1),
         jnp.argmax(crossed, axis=-1),
-        probs.shape[-1] - 1,
+        v - 1,
     )
     thresh = jnp.take_along_axis(sorted_probs, cross[..., None], axis=-1)
-    topp_valid = jnp.logical_and(topp_col > 0.0, topp_col < 1.0)
-    masked = jnp.where(probs >= thresh, probs, 0.0)
+    # never-crosses fallback: thresh is the smallest filtered value (> 0
+    # rows kept), so the support is exactly the cutoff-filtered set
+    thresh = jnp.maximum(thresh, cutoff)
+    masked = jnp.where(pf >= thresh, pf, 0.0)
     return jnp.where(topp_valid, masked, probs)
 
 
@@ -588,11 +598,21 @@ class InferenceEngine:
         precision = self._precision
         park = self._park
 
+        seq_len = self.header.seq_len
+
         @partial(jax.jit, donate_argnums=(2,))
         def block(params, token, cache, pos_vec, active, rng, temperature, topp):
             def body(i, carry):
                 tok, cache, out = carry
-                cur = jnp.where(active, pos_vec + i, park)
+                # per-lane in-block stop: a lane whose window fills mid-
+                # block parks itself (writes land in padding, token 0
+                # emitted) instead of shrinking the whole batch's block to
+                # its remaining space — one near-full lane no longer
+                # degrades every concurrent stream to 1-token dispatches
+                # (ADVICE r2 #2); callers already deactivate a lane the
+                # moment its position cap is reached.
+                ok = jnp.logical_and(active, pos_vec + i < seq_len)
+                cur = jnp.where(ok, pos_vec + i, park)
                 ctx = (
                     jax.default_matmul_precision(precision)
                     if precision
@@ -608,7 +628,7 @@ class InferenceEngine:
                 nxt = _sample_on_device(
                     last, temperature, topp, jax.random.fold_in(rng, i)
                 )
-                nxt = jnp.where(active, nxt, 0).reshape(-1, 1)
+                nxt = jnp.where(ok, nxt, 0).reshape(-1, 1)
                 out = lax.dynamic_update_index_in_dim(out, nxt[:, 0], i, axis=0)
                 return nxt, cache, out
 
@@ -633,8 +653,13 @@ class InferenceEngine:
         """Decode `n_steps` tokens on every ACTIVE lane in one device
         dispatch, each lane at its own position (and its own sampling
         settings — temperature 0 decodes that lane greedily). Returns
-        [n_steps][lanes] (parked lanes report token 0). `n_steps` is
-        clamped so no active lane runs past seqLen."""
+        [n_steps][lanes] (parked lanes report token 0). A lane that fills
+        its window MID-BLOCK parks itself on device and reports 0 for the
+        remaining rows — callers must stop consuming a lane's rows once
+        its position cap is reached (both the API scheduler and
+        generate_batch already do); the block length is clamped only by
+        the DEEPEST live lane, so one near-full lane doesn't reduce the
+        whole batch to tiny dispatches."""
         self._require_lanes()
         if len(tokens) != self.batch_size or len(pos) != self.batch_size:
             raise ValueError("tokens/pos must have one entry per lane")
@@ -644,7 +669,7 @@ class InferenceEngine:
         if not live:
             return []
         n_steps = min(
-            n_steps, min(self.header.seq_len - pos[i] for i in live)
+            n_steps, max(self.header.seq_len - pos[i] for i in live)
         )
         if n_steps <= 0:
             return []

