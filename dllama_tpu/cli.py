@@ -7,7 +7,9 @@ TPU-native replacements where the concept changed:
     --workers h:p ...   ->  --tp N      (chips on the slice, not LAN hosts;
                                          --workers N is accepted as an alias)
     --nthreads          ->  accepted, ignored (XLA owns threading)
-    --buffer-float-type ->  accepted (sync compression is moot over ICI)
+    --buffer-float-type ->  honored on multi-host launches (Q80 psum
+                            payloads, parallel/collectives.py); moot on
+                            single-host ICI where exact f32 is used
     --gpu-index/--gpu-segments -> rejected (the TPU *is* the device)
 
 Per-token timing surface mirrors dllama.cpp:59-66,88-95 (Eval/Pred + Sync
@@ -32,7 +34,12 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--topp", type=float, default=0.9)
     p.add_argument("--seed", type=int, default=int(time.time()))
     p.add_argument("--max-seq-len", type=int, default=0)
-    p.add_argument("--buffer-float-type", default="q80", help="accepted for CLI parity; ICI needs no sync compression")
+    p.add_argument("--buffer-float-type", default="q80",
+                   choices=["q80", "f32"],
+                   help="partial-sum all-reduce payload; applied on "
+                        "multi-host (DCN) launches, where sync bytes "
+                        "matter like the reference's 1 GbE clusters — "
+                        "single-host ICI always syncs exact f32")
     p.add_argument("--nthreads", type=int, default=1, help="accepted for CLI parity; XLA owns threading")
     p.add_argument("--net-turbo", type=int, default=1, help="accepted for CLI parity")
     p.add_argument("--nbatches", "--n-batches", type=int, default=32, dest="nbatches", help="prefill chunk size")
@@ -111,6 +118,11 @@ def load_engine(args):
         from .parallel.mesh import auto_tp
 
         tp = auto_tp(args.model, n_devices=len(jax.devices()) // sp)
+    # the reference's q80 sync compression pays on DCN (multi-host), not
+    # ICI: honor the flag only when processes > 1 (parallel/collectives.py)
+    buffer_ft = (
+        args.buffer_float_type if jax.process_count() > 1 else "f32"
+    )
     engine = InferenceEngine(
         args.model,
         tokenizer=tok,
@@ -125,6 +137,7 @@ def load_engine(args):
         prefill_buckets=tuple(sorted({1, args.nbatches, 512})),
         weight_format=args.weight_format,
         batch_size=getattr(args, "batch_size", 1),
+        buffer_float_type=buffer_ft,
     )
     h = engine.header
     print(f"💡 Arch: {h.arch.name}")
@@ -174,8 +187,17 @@ def run_inference(args) -> None:
     # all-reduces once per token.
     from .utils.telemetry import ici_traffic_per_token as _ici
 
-    per_tok_bytes = _ici(engine.header, engine.tp, include_logits=False)
-    logits_bytes = _ici(engine.header, engine.tp) - per_tok_bytes
+    # q80-compressed sync moves 1.125 B/elem (int8 + f32/32 scales);
+    # exact f32 psum moves 4
+    act_bytes = 1.125 if engine._sync_quant else 4.0
+    per_tok_bytes = _ici(
+        engine.header, engine.tp, activation_bytes=act_bytes,
+        include_logits=False,
+    )
+    logits_bytes = (
+        _ici(engine.header, engine.tp, activation_bytes=act_bytes)
+        - per_tok_bytes
+    )
 
     print(args.prompt)
     with profile(args.profile):

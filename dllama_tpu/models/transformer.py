@@ -43,12 +43,14 @@ KvCache = Dict[str, jnp.ndarray]
 _NEG_INF = -1e30
 
 
-def _mm(x: jnp.ndarray, w, role: str, mesh) -> jnp.ndarray:
+def _mm(x: jnp.ndarray, w, role: str, mesh, sync_quant: bool = False) -> jnp.ndarray:
     """Matmul dispatch: dense [in, out] weights take the einsum path (GSPMD
     partitions them via the NamedSharding specs); Q40 QuantWeight leaves take
-    the Pallas kernel (shard_map'd per TP role on a mesh)."""
+    the Pallas kernel (shard_map'd per TP role on a mesh). `sync_quant`
+    Q80-compresses the col-split partial-sum all-reduce payload
+    (reference: --buffer-float-type q80)."""
     if isinstance(w, QuantWeight):
-        return qmatmul_tp(x, w, role, mesh).astype(x.dtype)
+        return qmatmul_tp(x, w, role, mesh, sync_quant=sync_quant).astype(x.dtype)
     return jnp.einsum("bti,io->bto", x, w)
 
 
@@ -94,10 +96,6 @@ def _attention_tp(
     b, t = q.shape[0], q.shape[1]
     per_lane = jnp.ndim(pos) == 1
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        if per_lane:
-            raise NotImplementedError(
-                "per-lane positions are not supported with sp > 1"
-            )
         return _attention_sp(q, k_cache, v_cache, pos, head_dim, mesh)
     on_tpu = jax.default_backend() == "tpu"
     s = k_cache.shape[2]
@@ -140,6 +138,9 @@ def _attention_sp(
     Decode (T=1): every sp shard computes online-softmax partial state over
     its local KV rows, merged with a log-sum-exp pmax/psum — the collective
     payload is [B, KH, G, 1(, hd)], tiny next to the cache reads it saves.
+    `pos` may be a [B] per-lane vector (continuous batching composes with
+    sp): the stats math broadcasts per-lane query positions, and a parked
+    lane's strongly negative sentinel masks it on every shard.
 
     Prefill (T % sp == 0): queries shard over sp too and the KV shards
     rotate around the ring (parallel/ring_attention.ring_attention_local),
@@ -158,6 +159,8 @@ def _attention_sp(
     sp = mesh.shape["sp"]
     shard = s // sp
     kv_spec = P("dp", "tp", "sp", None)
+    per_lane = jnp.ndim(pos) == 1
+    pos_spec = P("dp") if per_lane else P()
 
     if t == 1:
         q_spec = P("dp", None, "tp", None)
@@ -210,7 +213,7 @@ def _attention_sp(
     out = shard_map(
         body,
         mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec, P()),
+        in_specs=(q_spec, kv_spec, kv_spec, pos_spec),
         out_specs=q_spec,
         check_vma=False,
     )(q, k_cache, v_cache, pos)
@@ -360,6 +363,7 @@ def _moe_ffn_pallas(
     n_active: int,
     mesh,
     interpret: bool = False,
+    sync_quant: bool = False,
 ) -> jnp.ndarray:
     """Decode-step MoE via the ragged Pallas kernel (ops/moe_kernel.py):
     each token's top-k expert ids drive the HBM->VMEM DMA schedule, so only
@@ -407,8 +411,10 @@ def _moe_ffn_pallas(
         else:
             in_specs = (tok, row_q, col_q, row_q, tok, tok)
 
+        from ..parallel.collectives import psum_maybe_quantized
+
         def body(*args):
-            return lax.psum(run(*args), "tp")
+            return psum_maybe_quantized(run(*args), "tp", sync_quant)
 
         out = shard_map(
             body,
@@ -431,6 +437,7 @@ def forward(
     attn_window: int = 0,
     attn_park_threshold: int = 0,
     logits_mode: str = "all",
+    sync_quant: bool = False,
 ) -> Tuple[jnp.ndarray, KvCache]:
     """Run the decoder on T tokens starting at absolute position `pos`.
 
@@ -523,7 +530,7 @@ def forward(
         else:
             k_view, v_view = k_cache_l, v_cache_l
         z = _attention_tp(q, k_view, v_view, attn_pos, h.head_dim, mesh)
-        x = x + _mm(z, lp["wo"], "col", mesh).astype(x.dtype)
+        x = x + _mm(z, lp["wo"], "col", mesh, sync_quant).astype(x.dtype)
 
         # -- FFN block (reference: src/llm.cpp:405-557) --
         y = rms_norm(x, lp["ffn_norm"], h.norm_epsilon)
@@ -534,14 +541,21 @@ def forward(
             # experts (XLA's jnp.take gather measured ~3x slower than even
             # dense, so the gather path stays opt-in via
             # moe_gather_max_tokens).
+            from ..ops.moe_kernel import moe_pallas_supported
+
+            _w1 = lp["w1"]
+            _quantized = isinstance(_w1, QuantWeight)
+            _itemsize = 1 if _quantized else _w1.dtype.itemsize
+            _f = _w1.q.shape[-1] if _quantized else _w1.shape[-1]
             if (
                 b * t <= MOE_PALLAS_MAX_TOKENS
                 and h.hidden_act == HiddenAct.SILU
                 and jax.default_backend() == "tpu"
+                and moe_pallas_supported(h.dim, _f, _quantized, _itemsize)
             ):
                 f = _moe_ffn_pallas(
                     y, lp["moe_gate"], lp["w1"], lp["w2"], lp["w3"],
-                    h.n_active_experts, mesh,
+                    h.n_active_experts, mesh, sync_quant=sync_quant,
                 )
             else:
                 moe = (
@@ -561,7 +575,7 @@ def forward(
         else:
             d = act(_mm(y, lp["w1"], "row", mesh))
             l = _mm(y, lp["w3"], "row", mesh)
-            f = _mm(d * l.astype(d.dtype), lp["w2"], "col", mesh)
+            f = _mm(d * l.astype(d.dtype), lp["w2"], "col", mesh, sync_quant)
         x = x + f.astype(x.dtype)
         return x, (k_cache_l, v_cache_l)
 

@@ -71,7 +71,31 @@ def _pick_f_block(f: int, d: int, quantized: bool, itemsize: int = 2) -> int:
         if f % b == 0:
             best = b
         b += step
-    return best or f
+    if best:
+        return best
+    if f <= max(budget_bf, step):
+        return f  # small shapes: whole F fits, no blocking needed
+    # no legal divisor AND whole-F busts the VMEM budget: refuse loudly
+    # (callers gate on moe_pallas_supported and fall back to the dense
+    # path) instead of shipping a kernel the real compiler will reject
+    raise ValueError(
+        f"no Mosaic-legal F block for F={f}, D={d} (need a multiple-of-"
+        f"{step} divisor within the {_TILE_BUDGET_BYTES // 10**6} MB tile "
+        "budget); use the dense MoE path"
+    )
+
+
+def moe_pallas_supported(
+    d: int, f: int, quantized: bool, itemsize: int = 2
+) -> bool:
+    """Whether the ragged kernels can tile this expert shape inside the
+    scoped-VMEM budget (transformer.forward gates the Pallas MoE path on
+    this and keeps the dense path otherwise)."""
+    try:
+        _pick_f_block(f, d, quantized, itemsize)
+        return True
+    except ValueError:
+        return False
 
 
 def _swiglu_accum(x, w1_f, w3_f, w2_f, routing_w, ti, ki, fi, n_k, n_f,

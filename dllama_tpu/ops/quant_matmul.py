@@ -204,6 +204,9 @@ def qmatmul_tp(
     w: QuantWeight,  # [in, out] (+ scales), possibly tp-sharded
     role: str,  # "row" (out split) | "col" (in split, partial-sum psum)
     mesh=None,
+    sync_quant: bool = False,  # Q80-compress the col-split partial-sum
+    #   all-reduce payload (the reference's --buffer-float-type q80; see
+    #   parallel/collectives.psum_q80) — for DCN multi-host, not ICI
 ) -> jnp.ndarray:
     """Tensor-parallel quantized matmul.
 
@@ -237,6 +240,8 @@ def qmatmul_tp(
             return qmatmul(xx, QuantWeight(qq, dd))
 
     elif role == "col":
+        from ..parallel.collectives import psum_maybe_quantized
+
         in_specs = (
             P("dp", None, "tp"),
             P("tp", None),
@@ -245,7 +250,9 @@ def qmatmul_tp(
         out_spec = P("dp", None, None)
 
         def f(xx, qq, dd):
-            return jax.lax.psum(qmatmul(xx, QuantWeight(qq, dd)), "tp")
+            return psum_maybe_quantized(
+                qmatmul(xx, QuantWeight(qq, dd)), "tp", sync_quant
+            )
 
     else:
         raise ValueError(f"unknown role: {role}")

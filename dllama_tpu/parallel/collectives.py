@@ -1,0 +1,75 @@
+"""Quantized collective payloads — the reference's Q80 sync buffer, SPMD-style.
+
+The reference cuts cross-node sync traffic to ~26% of f32 by quantizing
+the ZQ activation pipe to Q80 (int8 values + per-32-block scales) before
+every SYNC_NODE_SLICES all-gather, then dequantizing and summing locally
+(--buffer-float-type q80; src/llm.cpp:195, README.md:89). Its all-reduce
+IS that all-gather + local OP_MERGE_ADD sum (src/nn/nn-cpu-ops.cpp:920-957)
+— which is exactly reproducible under shard_map:
+
+    psum_q80(x) = sum over participants of dequant(all_gather(quant(x)))
+
+Payload per element: 1 B values + 4/32 B scales = 1.125 B vs 4 B f32
+(~28%). Over single-host ICI the compression is unnecessary (ICI bandwidth
+dwarfs the payload; the exact f32 psum is the default) — the win is on
+DCN-connected multi-host pods, the same regime the reference built Q80
+sync for on 1 GbE clusters.
+
+Quantization error matches the reference's regime: int8 rounding against a
+per-32-block amax scale (the reference uses the identical block structure;
+its scales are f16, ours f32 — scale traffic is 3% of payload either way).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Q_BLOCK = 32
+
+
+def quantize_q80_blocks(x: jnp.ndarray):
+    """Per-32-block symmetric int8 quantization along the LAST axis.
+
+    Returns (q int8 [..., n], scale f32 [..., n // 32]). Matches the
+    reference's Q80 block structure (NnBlockQ80, src/nn/nn-quants.hpp:69-72):
+    scale = amax / 127, q = round(x / scale). All-zero blocks quantize to
+    scale 0 / q 0."""
+    *lead, n = x.shape
+    assert n % Q_BLOCK == 0, f"last dim {n} not divisible by {Q_BLOCK}"
+    xf = x.astype(jnp.float32).reshape(*lead, n // Q_BLOCK, Q_BLOCK)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = amax / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(xf / safe[..., None]), -127, 127).astype(jnp.int8)
+    return q.reshape(*lead, n), scale
+
+
+def dequantize_q80_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of `quantize_q80_blocks`; f32 [..., n]."""
+    *lead, n = q.shape
+    qf = q.astype(jnp.float32).reshape(*lead, n // Q_BLOCK, Q_BLOCK)
+    return (qf * scale[..., None]).reshape(*lead, n)
+
+
+def psum_q80(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """All-reduce with Q80-quantized payload: each participant quantizes
+    its partial sum, all-gathers the int8 blocks + scales, and sums the
+    dequantized shards locally — byte-for-byte the reference's
+    SYNC_NODE_SLICES(q80 ZQ pipe) + OP_MERGE_ADD design. Call under
+    shard_map. Returns f32 in x's shape."""
+    q, scale = quantize_q80_blocks(x)
+    qg = lax.all_gather(q, axis_name)  # [n_dev, ..., n]
+    sg = lax.all_gather(scale, axis_name)
+    return jnp.sum(dequantize_q80_blocks(qg, sg), axis=0).astype(x.dtype)
+
+
+def psum_maybe_quantized(
+    x: jnp.ndarray, axis_name: str, quantized: bool
+) -> jnp.ndarray:
+    """`lax.psum` (exact, the ICI default) or `psum_q80` (compressed, the
+    DCN/multi-host payload the reference calls --buffer-float-type q80)."""
+    if quantized:
+        return psum_q80(x, axis_name)
+    return lax.psum(x, axis_name)

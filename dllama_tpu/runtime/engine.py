@@ -137,6 +137,7 @@ class InferenceEngine:
         prefill_buckets: tuple[int, ...] = DEFAULT_PREFILL_BUCKETS,
         matmul_precision: str | None = None,
         weight_format: str = "auto",
+        buffer_float_type: str = "f32",
     ):
         self.reader = ModelReader(model_path, max_seq_len=max_seq_len)
         self.header: LlmHeader = self.reader.header
@@ -181,6 +182,17 @@ class InferenceEngine:
                 else "dense"
             )
         self.weight_format = weight_format
+        # Q80-compressed partial-sum all-reduces (the reference's
+        # --buffer-float-type q80, src/llm.cpp:195): worthwhile on
+        # DCN-connected multi-host pods where sync bytes are the
+        # bottleneck; over single-host ICI the exact f32 psum is the
+        # right default (ICI bandwidth dwarfs the [dim] payload).
+        if buffer_float_type not in ("f32", "q80"):
+            raise ValueError(
+                f"buffer_float_type must be 'f32' or 'q80', got "
+                f"{buffer_float_type!r}"
+            )
+        self._sync_quant = buffer_float_type == "q80"
         if weight_format == "q40" and tp > 1:
             # col-split quant weights shard the scale tensor's block axis
             # (in//32): every contraction dim must divide by 32*tp
@@ -203,10 +215,13 @@ class InferenceEngine:
         # Per-lane serving: lanes park their cache writes in padding rows
         # beyond seqLen while other lanes prefill/idle, so independent
         # requests can occupy the batch lanes at different positions.
-        # Padding must cover the widest chunk a parked lane "writes".
-        self._lane_pad = (
-            max(self.prefill_buckets) if (batch_size > 1 and sp == 1) else 0
-        )
+        # Padding must cover the widest chunk a parked lane "writes";
+        # under sp it is rounded up so the padded sequence axis still
+        # tiles across the sp shards.
+        pad = max(self.prefill_buckets) if batch_size > 1 else 0
+        if pad and sp > 1:
+            pad += (-pad) % sp
+        self._lane_pad = pad
         self._park = self.header.seq_len  # first padding row
         self._cache_sharding = {
             k: NamedSharding(self.mesh, spec)
@@ -246,16 +261,20 @@ class InferenceEngine:
 
     def _attn_window(self, limit: int) -> int:
         """Smallest power-of-2 window >= limit (min 512) covering the live
-        cache prefix; full seq_len when nothing smaller fits. One compiled
-        program per window keeps decode reads proportional to the context
-        actually used instead of the allocated seq_len."""
+        cache prefix; full seq_len when nothing smaller fits; 0 (= no
+        slicing) under sp. One compiled program per window keeps decode
+        reads proportional to the context actually used instead of the
+        allocated seq_len — O(pos) decode reads live HERE, not in a
+        kernel: round-3 silicon showed Mosaic does not elide repeated-
+        index DMAs, and windowed XLA dense attention beats the Pallas
+        decode kernel (scripts/decode_probe.py)."""
         s = self.header.seq_len
         if self.sp > 1:
             # windowing would slice the sp-sharded sequence axis out of
-            # alignment, so sp runs read the full per-shard cache each step
-            # (1/sp of the global cache; a shard-local pos-clamped decode
-            # kernel to bound this further is in ROADMAP.md)
-            return s
+            # alignment (and, with lane padding, mid-shard), so sp runs
+            # read the full per-shard cache each step (1/sp of the global
+            # cache)
+            return 0
         w = 512
         while w < limit:
             w *= 2
@@ -264,21 +283,6 @@ class InferenceEngine:
         # log2(seq_len/512) of them worst case, amortized by the on-disk
         # compilation cache across runs).
         return min(w, s)
-
-    def _decode_window(self, limit: int) -> int:
-        """Window for T=1 decode programs: the bucketed power-of-2 window
-        on every backend. (Round-3 silicon falsified the flash-decode
-        alternative: Mosaic does not elide repeated-index DMAs, so a
-        full-cache Pallas program reads all S rows at every step — the
-        windowed XLA dense program reads ~2*pos instead and is faster per
-        row; see scripts/decode_probe.py. One compiled program per window,
-        log2(seq_len/512) worst case, amortized by the compilation
-        cache.)"""
-        if self.sp > 1:
-            # full sharded cache view: each sp shard scores its 1/sp of
-            # the rows (dense, masked) and merges stats — see _attn_window
-            return 0
-        return self._attn_window(limit)
 
     def _step_fn(self, t: int, greedy: bool, window: int = 0):
         """Build/jit the forward step for chunk length `t`."""
@@ -301,6 +305,7 @@ class InferenceEngine:
                 logits, cache = forward(
                     params, h, tokens, pos, cache, mesh=mesh,
                     attn_window=window, logits_mode="last",
+                    sync_quant=self._sync_quant,
                 )
             last = logits[:, -1, :]
             if greedy:
@@ -341,6 +346,7 @@ class InferenceEngine:
                     logits, cache = forward(
                         params, h, tok, pos + i, cache, mesh=mesh,
                         attn_window=window, logits_mode="last",
+                        sync_quant=self._sync_quant,
                     )
                 last = logits[:, -1, :]
                 if greedy:
@@ -384,7 +390,7 @@ class InferenceEngine:
             arr = jnp.asarray([[token]] * self.batch_size, dtype=jnp.int32)
         arr = jax.device_put(arr, self._token_sharding)
         greedy = self.temperature == 0.0
-        window = self._decode_window(pos + n_steps)
+        window = self._attn_window(pos + n_steps)
         block = self._decode_block_fn(n_steps, greedy, window)
         # fold in a call counter so successive generations differ (the
         # reference's xorshift state advances across calls the same way)
@@ -427,7 +433,8 @@ class InferenceEngine:
             )
             with ctx:
                 logits, cache = forward(
-                    params, h, tokens, pos, cache, mesh=mesh, attn_window=window
+                    params, h, tokens, pos, cache, mesh=mesh,
+                    attn_window=window, sync_quant=self._sync_quant,
                 )
             lg = logits.astype(jnp.float32)  # [B, T, V]
             lse = jax.nn.logsumexp(lg, axis=-1)  # [B, T]
@@ -507,7 +514,7 @@ class InferenceEngine:
     def _require_lanes(self) -> None:
         if self._lane_pad == 0:
             raise ValueError(
-                "per-lane serving needs batch_size > 1 and sp == 1 "
+                "per-lane serving needs batch_size > 1 "
                 "(lanes park their writes in cache padding rows)"
             )
 
@@ -534,7 +541,7 @@ class InferenceEngine:
                 _, cache = forward(
                     params, h, tokens, pos_vec, cache, mesh=mesh,
                     attn_window=window, attn_park_threshold=park,
-                    logits_mode="last",
+                    logits_mode="last", sync_quant=self._sync_quant,
                 )
             return cache
 
@@ -623,6 +630,7 @@ class InferenceEngine:
                         params, h, tok, cur, cache, mesh=mesh,
                         attn_window=window,
                         attn_park_threshold=park, logits_mode="last",
+                        sync_quant=self._sync_quant,
                     )
                 last = logits[:, -1, :]
                 nxt = _sample_on_device(
@@ -788,7 +796,7 @@ class InferenceEngine:
         arr = jnp.asarray([[token]] * self.batch_size, dtype=jnp.int32)
         arr = jax.device_put(arr, self._token_sharding)
         greedy = self.temperature == 0.0
-        step = self._step_fn(1, greedy=greedy, window=self._decode_window(pos + 1))
+        step = self._step_fn(1, greedy=greedy, window=self._attn_window(pos + 1))
         t0 = time.perf_counter()
         out, self.cache = step(self.params, arr, self.cache, jnp.int32(pos))
         out = jax.block_until_ready(out)

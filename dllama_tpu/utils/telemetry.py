@@ -95,7 +95,8 @@ def memory_report(params, cache, n_devices: int = 1) -> MemoryReport:
 
 
 def ici_traffic_per_token(
-    h: LlmHeader, tp: int, activation_bytes: int = 2, include_logits: bool = True
+    h: LlmHeader, tp: int, activation_bytes: float = 2.0,
+    include_logits: bool = True,
 ) -> int:
     """Analytic per-decoded-token ICI bytes per chip for the TP layout.
 
@@ -103,7 +104,10 @@ def ici_traffic_per_token(
     col-split wo and the FFN's col-split w2 — where the reference ran
     SYNC_NODE_SLICES + MERGE_ADD, llm.cpp:403,554) plus the logits
     all-gather (vocab/tp per chip receives the rest). Ring all-reduce moves
-    2*(tp-1)/tp of the payload per chip.
+    2*(tp-1)/tp of the payload per chip. `activation_bytes`: 4 for the
+    f32 psum payload, 1.125 for Q80-compressed sync
+    (buffer_float_type="q80", parallel/collectives.psum_q80 — the
+    reference's README.md:89 ~26% figure), 2 for bf16 GSPMD all-reduces.
     """
     if tp <= 1:
         return 0

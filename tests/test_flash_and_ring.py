@@ -298,3 +298,28 @@ def test_ring_with_flash_local_step():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(expected), rtol=2e-4, atol=2e-4
     )
+
+
+def test_moe_pallas_tp_q80_sync_close():
+    """The MoE TP branch with Q80-compressed partial-sum psum
+    (sync_quant=True; parallel/collectives.psum_q80) must stay within
+    quantization tolerance of the exact-psum result on a tp=2 mesh."""
+    from dllama_tpu.models.transformer import _moe_ffn_pallas
+
+    rng = np.random.default_rng(23)
+    E, D, F, K = 8, 64, 128, 3
+    w1 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.standard_normal((E, F, D)).astype(np.float32) * 0.1)
+    w3 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.1)
+    gate = jnp.asarray(rng.standard_normal((D, E)).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((1, 1, D)).astype(np.float32))
+
+    mesh = make_mesh(tp=2)
+    exact = _moe_ffn_pallas(x, gate, w1, w2, w3, K, mesh, interpret=True)
+    q80 = _moe_ffn_pallas(
+        x, gate, w1, w2, w3, K, mesh, interpret=True, sync_quant=True
+    )
+    scale = float(np.abs(np.asarray(exact)).max())
+    err = float(np.abs(np.asarray(q80) - np.asarray(exact)).max())
+    assert err / scale < 2e-2, (err, scale)
+    assert err > 0.0  # the compressed path actually took effect
