@@ -426,6 +426,85 @@ def _moe_ffn_pallas(
     return out.reshape(b, t, d).astype(x.dtype)
 
 
+def _moe_ffn_grouped(
+    x: jnp.ndarray,  # [B, T, D] prefill-scale B*T
+    gate_w: jnp.ndarray,
+    w1,  # [E, D, F] dense or QuantWeight
+    w2,
+    w3,
+    n_active: int,
+    mesh,
+    interpret: bool = False,
+    sync_quant: bool = False,
+) -> jnp.ndarray:
+    """Prefill MoE via the grouped active-expert kernel
+    (ops/moe_kernel.moe_grouped_experts*): assignments sorted by expert,
+    expert weights streamed once per overlapping row tile — FLOPs and
+    HBM reads proportional to the ACTIVE experts, where the dense prefill
+    path paid the full E/k factor (VERDICT r2 missing #3; reference
+    active-only semantics src/nn/nn-cpu-ops.cpp:1104-1136). TP layout
+    matches _moe_ffn_pallas: experts F-sliced over tp, partial outputs
+    psum'd; routing and the schedule are computed per shard from the
+    shard's tokens."""
+    from ..ops.moe_kernel import (
+        moe_grouped_experts,
+        moe_grouped_experts_q40,
+    )
+
+    b, t, d = x.shape
+    n = b * t
+    xf = x.reshape(n, d)
+    quantized = isinstance(w1, QuantWeight)
+    # route ONCE, outside any shard_map (same as _moe_ffn_pallas): the
+    # gate einsum + top_k would otherwise rerun per tp shard
+    top_i, wts = _moe_route(xf, gate_w, n_active)
+
+    def run(xx, ii, ww, *wargs):
+        if quantized:
+            w1q, w1d, w2q, w2d, w3q, w3d = wargs
+            return moe_grouped_experts_q40(
+                xx, w1q, w1d, w2q, w2d, w3q, w3d, ii, ww,
+                interpret=interpret,
+            )
+        ww1, ww2, ww3 = wargs
+        return moe_grouped_experts(
+            xx, ww1, ww2, ww3, ii, ww, interpret=interpret
+        )
+
+    operands = (
+        (xf, top_i, wts, w1.q, w1.d, w2.q, w2.d, w3.q, w3.d)
+        if quantized
+        else (xf, top_i, wts, w1, w2, w3)
+    )
+    if mesh is None or mesh.devices.size == 1:
+        out = run(*operands)
+    else:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.collectives import psum_maybe_quantized
+
+        tok = P("dp", None) if (n % mesh.shape.get("dp", 1) == 0 and n > 1) else P()
+        row_q = P(None, None, "tp")
+        col_q = P(None, "tp", None)
+        if quantized:
+            in_specs = (tok, tok, tok, row_q, row_q, col_q, col_q, row_q, row_q)
+        else:
+            in_specs = (tok, tok, tok, row_q, col_q, row_q)
+
+        def body(*args):
+            return psum_maybe_quantized(run(*args), "tp", sync_quant)
+
+        out = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=tok,
+            check_vma=False,
+        )(*operands)
+    return out.reshape(b, t, d).astype(x.dtype)
+
+
 def forward(
     params: Params,
     h: LlmHeader,
@@ -547,13 +626,21 @@ def forward(
             _quantized = isinstance(_w1, QuantWeight)
             _itemsize = 1 if _quantized else _w1.dtype.itemsize
             _f = _w1.q.shape[-1] if _quantized else _w1.shape[-1]
-            if (
-                b * t <= MOE_PALLAS_MAX_TOKENS
-                and h.hidden_act == HiddenAct.SILU
+            pallas_ok = (
+                h.hidden_act == HiddenAct.SILU
                 and jax.default_backend() == "tpu"
                 and moe_pallas_supported(h.dim, _f, _quantized, _itemsize)
-            ):
-                f = _moe_ffn_pallas(
+            )
+            if pallas_ok:
+                # decode-sized token counts take the per-(token, choice)
+                # ragged kernel; prefill-scale takes the grouped kernel
+                # (FLOPs proportional to selected experts, not all E)
+                moe_kernel_fn = (
+                    _moe_ffn_pallas
+                    if b * t <= MOE_PALLAS_MAX_TOKENS
+                    else _moe_ffn_grouped
+                )
+                f = moe_kernel_fn(
                     y, lp["moe_gate"], lp["w1"], lp["w2"], lp["w3"],
                     h.n_active_experts, mesh, sync_quant=sync_quant,
                 )

@@ -292,3 +292,302 @@ def moe_active_experts_q40(
         top_i, weights.astype(jnp.float32),
         x.astype(jnp.float32), w1q, w1d, w3q, w3d, w2q, w2d,
     )
+
+
+# ---------------------------------------------------------------------------
+# Grouped (prefill-scale) ragged MoE: active experts only, tokens sorted by
+# expert. The decode kernels above dedicate one grid step per (token,
+# choice) — fine for lane-sized m, but prefill would re-read every selected
+# expert's weights per token. Here the B*T*k routing assignments are sorted
+# by expert id, row-tiled at R rows, and a STATIC-size schedule (computed
+# in jnp, delivered via scalar prefetch) gives each grid step one
+# (row-tile, expert-segment) pair: expert weights stream once per
+# overlapping tile (~once per occupied expert when tokens group well), and
+# FLOPs are proportional to assignments, not to E. This is the
+# megablocks-style grouped GEMM restated for Pallas-on-TPU (SURVEY.md §7's
+# "MoE top-k without a dense 128-expert matmul" hard part, at prefill
+# scale; reference active-only semantics: src/nn/nn-cpu-ops.cpp:1104-1136).
+# ---------------------------------------------------------------------------
+
+_GROUP_ROWS = 32  # row tile; worst-case wasted compute = E extra tiles
+
+
+def _grouped_schedule(top_i, weights, n_tokens, n_experts):
+    """jnp (traced) schedule for the grouped kernel.
+
+    Returns (t_sorted [A_pad], w_col [A_pad, 1], step_lo/hi/tile/expert
+    [G]) where A_pad pads the A = N*k sorted assignments to the row tile
+    and G = A_pad/R + E + 1 statically bounds the (tile, segment) pairs —
+    every extra distinct expert inside a tile adds one step, and there are
+    at most E+1 distinct ids (incl. the padding sentinel)."""
+    n, k = top_i.shape
+    a = n * k
+    r = _GROUP_ROWS
+    a_pad = -(-a // r) * r
+    n_tiles = a_pad // r
+    g_steps = n_tiles + n_experts + 1
+
+    flat_e = top_i.reshape(-1)
+    flat_t = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    flat_w = weights.reshape(-1).astype(jnp.float32)
+    order = jnp.argsort(flat_e, stable=True)
+    e_s = jnp.concatenate(
+        [flat_e[order], jnp.full((a_pad - a,), n_experts, flat_e.dtype)]
+    )
+    t_s = jnp.concatenate(
+        [flat_t[order], jnp.zeros((a_pad - a,), jnp.int32)]
+    )
+    w_s = jnp.concatenate(
+        [flat_w[order], jnp.zeros((a_pad - a,), jnp.float32)]
+    )
+
+    pos = jnp.arange(a_pad, dtype=jnp.int32)
+    prev_e = jnp.concatenate([jnp.full((1,), -1, e_s.dtype), e_s[:-1]])
+    step_start = jnp.logical_or(pos % r == 0, e_s != prev_e)
+    step_id = jnp.cumsum(step_start.astype(jnp.int32)) - 1  # [a_pad]
+
+    step_lo = jnp.full((g_steps,), a_pad, jnp.int32).at[step_id].min(pos)
+    step_hi = jnp.zeros((g_steps,), jnp.int32).at[step_id].max(pos) + 1
+    # empty trailing steps: lo=a_pad, hi=1 -> hi<=lo masks every row
+    step_tile = jnp.clip(step_lo // r, 0, n_tiles - 1)
+    step_expert = e_s[jnp.clip(step_lo, 0, a_pad - 1)]
+    step_expert = jnp.clip(step_expert, 0, n_experts - 1)  # sentinel -> any
+    return t_s, w_s[:, None], step_lo, step_hi, step_tile, step_expert
+
+
+def _grouped_kernel(
+    lo_ref, hi_ref, tile_ref, expert_ref,  # scalar prefetch [G] int32
+    x_ref,  # [R, D] bf16: this tile's sorted token rows
+    w_ref,  # [R, 1] f32: per-row routing weights (masked by segment here)
+    w1_ref,  # [1, D, bf]
+    w3_ref,  # [1, D, bf]
+    w2_ref,  # [1, bf, D]
+    o_ref,  # [R, D] f32
+    acc_ref,  # VMEM [R, D] f32
+    *,
+    n_f: int,
+    n_steps: int,
+    rows: int,
+):
+    g, fi = pl.program_id(0), pl.program_id(1)
+    tile = tile_ref[g]
+    prev_tile = tile_ref[jnp.maximum(g - 1, 0)]
+    next_tile = tile_ref[jnp.minimum(g + 1, n_steps - 1)]
+    new_tile = jnp.logical_or(g == 0, tile != prev_tile)
+    last_of_tile = jnp.logical_or(g == n_steps - 1, tile != next_tile)
+
+    @pl.when(jnp.logical_and(new_tile, fi == 0))
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[:].astype(w1_ref.dtype)
+    h1 = jax.lax.dot_general(
+        x, w1_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    h3 = jax.lax.dot_general(
+        x, w3_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    hidden = (h1 / (1.0 + jnp.exp(-h1))) * h3
+    out = jax.lax.dot_general(
+        hidden.astype(x.dtype), w2_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # rows outside this step's [lo, hi) segment belong to another expert
+    # (or to padding): their routing weight is forced to 0, so the wasted
+    # compute contributes exactly nothing
+    row_pos = tile * rows + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, 1), 0
+    )
+    in_seg = jnp.logical_and(row_pos >= lo_ref[g], row_pos < hi_ref[g])
+    w_rows = jnp.where(in_seg, w_ref[:], 0.0)
+    acc_ref[:] += out * w_rows
+
+    @pl.when(jnp.logical_and(last_of_tile, fi == n_f - 1))
+    def _emit():
+        o_ref[:] = acc_ref[:]
+
+
+def _grouped_x_map(g, fi, lo, hi, tile, expert):
+    return (tile[g], 0)
+
+
+def _grouped_row_map(g, fi, lo, hi, tile, expert):
+    return (tile[g], 0)
+
+
+def _grouped_w13_map(g, fi, lo, hi, tile, expert):
+    return (expert[g], 0, fi)
+
+
+def _grouped_w2_map(g, fi, lo, hi, tile, expert):
+    return (expert[g], fi, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def moe_grouped_experts(
+    x: jnp.ndarray,  # [N, D] tokens (prefill-scale N)
+    w1: jnp.ndarray,  # [E, D, F]
+    w2: jnp.ndarray,  # [E, F, D]
+    w3: jnp.ndarray,  # [E, D, F]
+    top_i: jnp.ndarray,  # [N, k] int32
+    weights: jnp.ndarray,  # [N, k] f32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Grouped active-expert SwiGLU MoE; [N, D] f32. See module section
+    comment: assignments sorted by expert, one grid step per (row tile,
+    expert segment), expert weights streamed once per overlapping tile."""
+    n, d = x.shape
+    e, _, f = w1.shape
+    k = top_i.shape[-1]
+    bf = _pick_f_block(f, d, quantized=False, itemsize=w1.dtype.itemsize)
+    n_f = f // bf
+    r = _GROUP_ROWS
+
+    t_s, w_col, lo, hi, tile, expert = _grouped_schedule(
+        top_i, weights, n, e
+    )
+    a_pad = t_s.shape[0]
+    g_steps = lo.shape[0]
+    x_sorted = jnp.take(x, t_s, axis=0).astype(jnp.bfloat16)  # [A_pad, D]
+
+    o_sorted = pl.pallas_call(
+        functools.partial(
+            _grouped_kernel, n_f=n_f, n_steps=g_steps, rows=r
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(g_steps, n_f),
+            in_specs=[
+                pl.BlockSpec((r, d), _grouped_x_map),
+                pl.BlockSpec((r, 1), _grouped_row_map),
+                pl.BlockSpec((1, d, bf), _grouped_w13_map),
+                pl.BlockSpec((1, d, bf), _grouped_w13_map),
+                pl.BlockSpec((1, bf, d), _grouped_w2_map),
+            ],
+            out_specs=pl.BlockSpec((r, d), _grouped_x_map),
+            scratch_shapes=[pltpu.VMEM((r, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((a_pad, d), jnp.float32),
+        interpret=interpret,
+    )(lo, hi, tile, expert, x_sorted, w_col, w1, w3, w2)
+    # weights ride in their NATIVE dtype — a pre-cast would materialize
+    # full all-expert copies, the exact all-E HBM cost this kernel avoids;
+    # the kernel casts x per tile to match instead
+
+    # scatter-add each weighted assignment back to its token (the
+    # reference's OP_SCALE + OP_MERGE_SUM combine, src/llm.cpp:489-499)
+    return jnp.zeros((n, d), jnp.float32).at[t_s].add(o_sorted)
+
+
+def _grouped_kernel_q40(
+    lo_ref, hi_ref, tile_ref, expert_ref,  # scalar prefetch [G] int32
+    x_ref,  # [R, D] bf16
+    w_ref,  # [R, 1] f32
+    w1q_ref,  # [1, D, bf] int8
+    w1d_ref,  # [1, D // 32, bf] f32
+    w3q_ref,  # [1, D, bf] int8
+    w3d_ref,  # [1, D // 32, bf] f32
+    w2q_ref,  # [1, bf, D] int8
+    w2d_ref,  # [1, bf // 32, D] f32
+    o_ref,  # [R, D] f32
+    acc_ref,  # VMEM [R, D] f32
+    *,
+    n_f: int,
+    n_steps: int,
+    rows: int,
+):
+    g, fi = pl.program_id(0), pl.program_id(1)
+    tile = tile_ref[g]
+    prev_tile = tile_ref[jnp.maximum(g - 1, 0)]
+    next_tile = tile_ref[jnp.minimum(g + 1, n_steps - 1)]
+    new_tile = jnp.logical_or(g == 0, tile != prev_tile)
+    last_of_tile = jnp.logical_or(g == n_steps - 1, tile != next_tile)
+
+    @pl.when(jnp.logical_and(new_tile, fi == 0))
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    w1 = _dequant_block(w1q_ref[0], w1d_ref[0])
+    w3 = _dequant_block(w3q_ref[0], w3d_ref[0])
+    w2 = _dequant_block(w2q_ref[0], w2d_ref[0])
+    x = x_ref[:]
+    h1 = jax.lax.dot_general(
+        x, w1, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    h3 = jax.lax.dot_general(
+        x, w3, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    hidden = (h1 / (1.0 + jnp.exp(-h1))) * h3
+    out = jax.lax.dot_general(
+        hidden.astype(x.dtype), w2,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    row_pos = tile * rows + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, 1), 0
+    )
+    in_seg = jnp.logical_and(row_pos >= lo_ref[g], row_pos < hi_ref[g])
+    acc_ref[:] += out * jnp.where(in_seg, w_ref[:], 0.0)
+
+    @pl.when(jnp.logical_and(last_of_tile, fi == n_f - 1))
+    def _emit():
+        o_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def moe_grouped_experts_q40(
+    x: jnp.ndarray,  # [N, D]
+    w1q: jnp.ndarray,  # [E, D, F] int8
+    w1d: jnp.ndarray,  # [E, D // 32, F] f32
+    w2q: jnp.ndarray,  # [E, F, D] int8
+    w2d: jnp.ndarray,  # [E, F // 32, D] f32
+    w3q: jnp.ndarray,  # [E, D, F] int8
+    w3d: jnp.ndarray,  # [E, D // 32, F] f32
+    top_i: jnp.ndarray,  # [N, k] int32
+    weights: jnp.ndarray,  # [N, k] f32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Quantized grouped active-expert MoE (see moe_grouped_experts):
+    selected experts' Q40 blocks stream once per overlapping row tile."""
+    n, d = x.shape
+    e, _, f = w1q.shape
+    bf = _pick_f_block(f, d, quantized=True)
+    n_f = f // bf
+    r = _GROUP_ROWS
+
+    t_s, w_col, lo, hi, tile, expert = _grouped_schedule(
+        top_i, weights, n, e
+    )
+    a_pad = t_s.shape[0]
+    g_steps = lo.shape[0]
+    x_sorted = jnp.take(x, t_s, axis=0).astype(jnp.bfloat16)
+
+    o_sorted = pl.pallas_call(
+        functools.partial(
+            _grouped_kernel_q40, n_f=n_f, n_steps=g_steps, rows=r
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(g_steps, n_f),
+            in_specs=[
+                pl.BlockSpec((r, d), _grouped_x_map),
+                pl.BlockSpec((r, 1), _grouped_row_map),
+                pl.BlockSpec((1, d, bf), _grouped_w13_map),
+                pl.BlockSpec((1, d // Q_BLOCK, bf), _grouped_w13_map),
+                pl.BlockSpec((1, d, bf), _grouped_w13_map),
+                pl.BlockSpec((1, d // Q_BLOCK, bf), _grouped_w13_map),
+                pl.BlockSpec((1, bf, d), _grouped_w2_map),
+                pl.BlockSpec((1, bf // Q_BLOCK, d), _grouped_w2_map),
+            ],
+            out_specs=pl.BlockSpec((r, d), _grouped_x_map),
+            scratch_shapes=[pltpu.VMEM((r, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((a_pad, d), jnp.float32),
+        interpret=interpret,
+    )(lo, hi, tile, expert, x_sorted, w_col,
+      w1q, w1d, w3q, w3d, w2q, w2d)
+
+    return jnp.zeros((n, d), jnp.float32).at[t_s].add(o_sorted)

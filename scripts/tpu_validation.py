@@ -190,6 +190,60 @@ def main() -> None:
                 / (np.abs(np.asarray(refq)).max() + 1e-9))
     record("ragged moe q40 rel err", f"{rel:.2e} {'OK' if rel < 5e-2 else 'FAIL'}")
 
+    # 3c. grouped active-expert PREFILL kernel on silicon: numerics vs the
+    # dense all-expert einsum at a prefill-scale token count, plus timing
+    from dllama_tpu.ops.moe_kernel import moe_grouped_experts
+
+    Np = 64 if quick else 256
+    xg = jnp.asarray(
+        rng.standard_normal((Np, D)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    idxg = jnp.asarray(
+        np.stack([rng.choice(E, K, replace=False) for _ in range(Np)]).astype(np.int32)
+    )
+    wtsg_raw = rng.random((Np, K)).astype(np.float32)
+    wtsg = jnp.asarray(wtsg_raw / wtsg_raw.sum(1, keepdims=True))
+    outg = moe_grouped_experts(xg, w1, w2, w3, idxg, wtsg)
+    xgf = np.asarray(xg, np.float32)
+    expg = np.zeros((Np, D), np.float32)
+    for t_i in range(Np):
+        for i, ei in enumerate(np.asarray(idxg)[t_i]):
+            h1 = xgf[t_i : t_i + 1] @ np.asarray(w1[ei], np.float32)
+            h3 = xgf[t_i : t_i + 1] @ np.asarray(w3[ei], np.float32)
+            expg[t_i] += float(wtsg[t_i, i]) * (
+                (h1 / (1 + np.exp(-h1)) * h3) @ np.asarray(w2[ei], np.float32)
+            )[0]
+    relg = float(np.abs(np.asarray(outg) - expg).max() / (np.abs(expg).max() + 1e-9))
+    record(
+        f"grouped moe prefill rel err (N={Np})",
+        f"{relg:.2e} {'OK' if relg < 5e-2 else 'FAIL'}",
+    )
+    # q40 twin: the quantized grouped kernel is what every quantized
+    # prefill routes through — it must meet real Mosaic here first
+    from dllama_tpu.ops.moe_kernel import moe_grouped_experts_q40
+
+    outgq = moe_grouped_experts_q40(
+        xg, qw1.q, qw1.d, qw2.q, qw2.d, qw3.q, qw3.d, idxg, wtsg
+    )
+    refgq = moe_grouped_experts(
+        xg, qw_dequant(qw1), qw_dequant(qw2), qw_dequant(qw3), idxg, wtsg
+    )
+    relgq = float(
+        np.abs(np.asarray(outgq) - np.asarray(refgq)).max()
+        / (np.abs(np.asarray(refgq)).max() + 1e-9)
+    )
+    record(
+        f"grouped moe q40 prefill rel err (N={Np})",
+        f"{relgq:.2e} {'OK' if relgq < 5e-2 else 'FAIL'}",
+    )
+    t_grouped = timeit(lambda: moe_grouped_experts(xg, w1, w2, w3, idxg, wtsg), n_iter=20)
+    f_dense_all = jax.jit(
+        lambda xx: jnp.einsum("nd,edf->nef", xx, w1)
+    )
+    t_dense_all = timeit(lambda: f_dense_all(xg), n_iter=20)
+    record(f"moe grouped prefill N={Np} (full swiglu)", f"{t_grouped:.2f} ms")
+    record(f"moe dense prefill N={Np} (w1 only, all E)", f"{t_dense_all:.2f} ms")
+
     t_ragged = timeit(lambda: moe_active_experts(xm, w1, w2, w3, idx, wts))
     t_ragged_q = timeit(
         lambda: moe_active_experts_q40(

@@ -323,3 +323,69 @@ def test_moe_pallas_tp_q80_sync_close():
     err = float(np.abs(np.asarray(q80) - np.asarray(exact)).max())
     assert err / scale < 2e-2, (err, scale)
     assert err > 0.0  # the compressed path actually took effect
+
+
+def _rand_moe(rng, E, D, F):
+    w1 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.standard_normal((E, F, D)).astype(np.float32) * 0.1)
+    w3 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.1)
+    gate = jnp.asarray(rng.standard_normal((D, E)).astype(np.float32))
+    return w1, w2, w3, gate
+
+
+def test_moe_grouped_matches_dense_routing():
+    """Prefill-scale grouped active-expert MoE (assignments sorted by
+    expert, static (tile, segment) schedule) vs the dense-over-all-experts
+    path — same routing, bf16 kernel tolerance. Covers partial tiles and
+    tiles spanning several expert segments (VERDICT r2 missing #3)."""
+    from dllama_tpu.models.transformer import _moe_ffn, _moe_ffn_grouped
+    from dllama_tpu.ops.jnp_ops import silu
+
+    rng = np.random.default_rng(41)
+    E, D, F = 8, 64, 128
+    w1, w2, w3, gate = _rand_moe(rng, E, D, F)
+    x = jnp.asarray(rng.standard_normal((2, 20, D)).astype(np.float32))
+
+    out = _moe_ffn_grouped(x, gate, w1, w2, w3, 3, mesh=None, interpret=True)
+    dense = _moe_ffn(x, gate, w1, w2, w3, 3, silu)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_moe_grouped_tp_and_q40():
+    """Grouped MoE through the tp=2 shard_map branch with Q40 experts vs
+    dense routing over dequantized experts."""
+    from dllama_tpu.formats.quants import q40_to_planar, quantize_q40
+    from dllama_tpu.models.transformer import _moe_ffn, _moe_ffn_grouped
+    from dllama_tpu.ops.jnp_ops import silu
+    from dllama_tpu.ops.quant_matmul import QuantWeight, dequant, from_planar
+
+    rng = np.random.default_rng(42)
+    E, D, F, K = 8, 64, 128, 3
+
+    def make_experts(out_dim, in_dim):
+        qs, ds = [], []
+        for _ in range(E):
+            w = rng.standard_normal((out_dim, in_dim)).astype(np.float32) * 0.1
+            qv, dv = q40_to_planar(quantize_q40(w), out_dim * in_dim)
+            qw = from_planar(qv.reshape(out_dim, in_dim),
+                             dv.reshape(out_dim, in_dim // 32))
+            qs.append(np.asarray(qw.q))
+            ds.append(np.asarray(qw.d))
+        return QuantWeight(jnp.asarray(np.stack(qs)), jnp.asarray(np.stack(ds)))
+
+    w1, w3 = make_experts(F, D), make_experts(F, D)
+    w2 = make_experts(D, F)
+    gate = jnp.asarray(rng.standard_normal((D, E)).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((2, 24, D)).astype(np.float32))
+
+    mesh = make_mesh(tp=2, dp=2)
+    out = _moe_ffn_grouped(x, gate, w1, w2, w3, K, mesh, interpret=True)
+    dense = _moe_ffn(
+        x, gate, dequant(w1, jnp.float32), dequant(w2, jnp.float32),
+        dequant(w3, jnp.float32), K, silu,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense), rtol=3e-2, atol=3e-2
+    )
