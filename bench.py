@@ -147,7 +147,9 @@ def main() -> None:
     mesh = make_mesh(tp=tp)
     t0 = time.perf_counter()
     params = random_params(
-        h, dtype=jnp.bfloat16, mesh=mesh, weight_format=weight_format
+        h, dtype=jnp.bfloat16, mesh=mesh, weight_format=weight_format,
+        # fused qkv/w13 launches, like the engine's q40 default
+        fuse=tp if weight_format == "q40" else 0,
     )
     cache = init_kv_cache(h, batch_size=1, dtype=jnp.bfloat16)
     cspecs = cache_specs(h)

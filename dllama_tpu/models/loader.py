@@ -22,7 +22,11 @@ import numpy as np
 from ..formats.model_file import LlmArch, LlmHeader, ModelReader
 from ..formats.quants import FloatType
 from ..ops.jnp_ops import rope_cache
-from ..ops.quant_matmul import QuantWeight, planar_to_device_layout
+from ..ops.quant_matmul import (
+    FusedQuantWeight,
+    QuantWeight,
+    planar_to_device_layout,
+)
 from ..utils import native
 from .transformer import Params
 
@@ -36,11 +40,35 @@ def _default_put(name: str, arr: np.ndarray) -> jnp.ndarray:
     return jnp.asarray(arr)
 
 
+def _interleave_concat(arrs: list[np.ndarray], tp: int) -> np.ndarray:
+    """Concatenate matmul weights along the out (last) axis in SHARD-MAJOR
+    order: [a_0 | b_0 | ... | a_1 | b_1 | ...] where x_i is tensor x's i-th
+    of `tp` out-dim slices. Under the row-split PartitionSpec (.., "tp")
+    each tp shard then holds its own slice of EVERY constituent, so one
+    fused kernel launch computes what separate launches did, and the
+    outputs un-interleave with local reshapes (transformer._split_fused) —
+    no cross-shard data movement."""
+    for a in arrs:
+        if a.shape[-1] % tp != 0:
+            raise ValueError(
+                f"fused out dim {a.shape[-1]} not divisible by tp={tp}"
+            )
+    if tp == 1:
+        return np.concatenate(arrs, axis=-1)
+    chunks = []
+    for s in range(tp):
+        for a in arrs:
+            o = a.shape[-1] // tp
+            chunks.append(a[..., s * o : (s + 1) * o])
+    return np.concatenate(chunks, axis=-1)
+
+
 def load_params(
     reader: ModelReader,
     dtype=jnp.float32,
     put: PutFn = _default_put,
     weight_format: str = "dense",
+    fuse: int = 0,
 ) -> Params:
     """Materialize the params pytree from a `.m` file.
 
@@ -55,6 +83,14 @@ def load_params(
     (the ragged kernel dequantizes selected blocks in VMEM), so a Q40 MoE
     file's device footprint stays ~1.125 B/weight instead of blowing up to
     bf16 density.
+
+    `fuse` (quantized path only): the tp shard count; > 0 emits fused
+    "wqkv" (q|k|v) and, for dense-FFN archs, "w13" (w1|w3) weights in
+    shard-major interleaved layout instead of the separate tensors —
+    decode drops from 7 to 4 Pallas launches per layer and reads the
+    activations once per pair (the round-3 silicon probe measured ~41 us
+    fixed cost per kernel launch; scripts/kernel_sweep.py). Must equal the
+    mesh's tp axis size.
     """
     h = reader.header
     quantize = weight_format == "q40"
@@ -114,7 +150,34 @@ def load_params(
     layers["ffn_norm"] = put(
         "ffn_norm", stack(lambda l: w(f"layers.{l}.ffn_norm", False))
     )
-    if quantize:
+    def qw_fused(tag: str, names: list[Callable[[int], str]]) -> FusedQuantWeight:
+        """Stacked FusedQuantWeight fusing several row-split matmul tensors
+        along the out axis, shard-major for `fuse` tp shards; the fuse
+        factor and constituent out dims ride as static pytree metadata."""
+        qs, ds = [], []
+        dims: tuple[int, ...] = ()
+        for l in range(h.n_layers):
+            parts = [unpack_q40(fn(l)) for fn in names]
+            dims = tuple(p[0].shape[-1] for p in parts)
+            qs.append(_interleave_concat([p[0] for p in parts], fuse))
+            ds.append(_interleave_concat([p[1] for p in parts], fuse))
+        return FusedQuantWeight(
+            QuantWeight(put(tag, np.stack(qs)), put(tag, np.stack(ds))),
+            fuse,
+            dims,
+        )
+
+    if quantize and fuse:
+        layers["wqkv"] = qw_fused(
+            "wqkv",
+            [
+                lambda l: f"layers.{l}.q",
+                lambda l: f"layers.{l}.k",
+                lambda l: f"layers.{l}.v",
+            ],
+        )
+        layers["wo"] = qw("wo", lambda l: f"layers.{l}.wo")
+    elif quantize:
         layers["wq"] = qw("wq", lambda l: f"layers.{l}.q")
         layers["wk"] = qw("wk", lambda l: f"layers.{l}.k")
         layers["wv"] = qw("wv", lambda l: f"layers.{l}.v")
@@ -161,6 +224,12 @@ def load_params(
             layers["w1"] = put("w1", stack(lambda l: experts(l, "w1")).astype(dtype))
             layers["w2"] = put("w2", stack(lambda l: experts(l, "w2")).astype(dtype))
             layers["w3"] = put("w3", stack(lambda l: experts(l, "w3")).astype(dtype))
+    elif quantize and fuse:
+        layers["w13"] = qw_fused(
+            "w13",
+            [lambda l: f"layers.{l}.w1", lambda l: f"layers.{l}.w3"],
+        )
+        layers["w2"] = qw("w2", lambda l: f"layers.{l}.w2")
     elif quantize:
         layers["w1"] = qw("w1", lambda l: f"layers.{l}.w1")
         layers["w2"] = qw("w2", lambda l: f"layers.{l}.w2")

@@ -82,6 +82,7 @@ def random_params(
     mesh=None,
     put=None,  # kept for API symmetry with load_params; unused when mesh given
     weight_format: str = "dense",
+    fuse: int = 0,
 ) -> Params:
     """Random params pytree with the loader's exact layout, generated
     directly ON DEVICE (jit + out_shardings): no multi-GB host->device
@@ -163,15 +164,29 @@ def random_params(
     layers = {
         "att_norm": mk("att_norm", L, D, norm=True),
         "ffn_norm": mk("ffn_norm", L, D, norm=True),
-        "wq": mm("wq", L, D, QD),
-        "wk": mm("wk", L, D, KD),
-        "wv": mm("wv", L, D, KD),
         "wo": mm("wo", L, QD, D),
         # MoE experts stay dense (same policy as the loader)
         "w1": mk("w1", L, E, D, FF) if moe else mm("w1", L, D, FF),
         "w2": mk("w2", L, E, FF, D) if moe else mm("w2", L, FF, D),
         "w3": mk("w3", L, E, D, FF) if moe else mm("w3", L, D, FF),
     }
+    if quant and fuse:
+        # fused-launch layout (loader `fuse`): the content is random either
+        # way, so generate the fused tensors directly in their shapes
+        from ..ops.quant_matmul import FusedQuantWeight
+
+        layers["wqkv"] = FusedQuantWeight(
+            mm("wqkv", L, D, QD + 2 * KD), fuse, (QD, KD, KD)
+        )
+        if not moe:
+            del layers["w1"], layers["w3"]
+            layers["w13"] = FusedQuantWeight(
+                mm("w13", L, D, 2 * FF), fuse, (FF, FF)
+            )
+    else:
+        layers["wq"] = mm("wq", L, D, QD)
+        layers["wk"] = mm("wk", L, D, KD)
+        layers["wv"] = mm("wv", L, D, KD)
     if moe:
         gate_key = jax.random.fold_in(root_key, 12345)
         layers["moe_gate"] = jax.jit(

@@ -54,6 +54,24 @@ def _mm(x: jnp.ndarray, w, role: str, mesh, sync_quant: bool = False) -> jnp.nda
     return jnp.einsum("bti,io->bto", x, w)
 
 
+def _split_fused(out: jnp.ndarray, tp: int, dims: tuple[int, ...]):
+    """Un-interleave a fused row-split matmul output [B, T, sum(dims)]
+    whose columns are laid out shard-major (loader._interleave_concat):
+    shard s's columns are [a_s | b_s | ...]. Returns one [B, T, dim]
+    array per constituent with its global column order restored. All ops
+    factor the tp-sharded axis into (tp, local) and slice the replicated
+    local axis, so under GSPMD they stay shard-local."""
+    b, t, total = out.shape
+    locs = [d // tp for d in dims]
+    assert sum(locs) * tp == total, (dims, tp, total)
+    o = out.reshape(b, t, tp, sum(locs))
+    parts, off = [], 0
+    for dl, dg in zip(locs, dims):
+        parts.append(o[..., off : off + dl].reshape(b, t, dg))
+        off += dl
+    return parts
+
+
 def init_kv_cache(
     h: LlmHeader, batch_size: int, dtype=jnp.float32, seq_len: int | None = None
 ) -> KvCache:
@@ -547,6 +565,8 @@ def forward(
     1B/128k-vocab shape), which lands directly on TTFT.
     """
     b, t = tokens.shape
+    # mesh tp size: per-shard shape checks (MoE kernel gate)
+    _tp_n = mesh.shape.get("tp", 1) if mesh is not None else 1
     interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
     act = silu if h.hidden_act == HiddenAct.SILU else gelu
     is_qwen3 = h.arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE)
@@ -591,9 +611,23 @@ def forward(
 
         # -- attention block (reference: src/llm.cpp:263-403) --
         y = rms_norm(x, lp["att_norm"], h.norm_epsilon)
-        q = _mm(y, lp["wq"], "row", mesh).reshape(b, t, h.n_heads, h.head_dim)
-        k = _mm(y, lp["wk"], "row", mesh).reshape(b, t, h.n_kv_heads, h.head_dim)
-        v = _mm(y, lp["wv"], "row", mesh).reshape(b, t, h.n_kv_heads, h.head_dim)
+        if "wqkv" in lp:
+            # fused q|k|v: one kernel launch reads y once (7 -> 4 launches
+            # per decode layer at ~41 us fixed cost each on the tunneled
+            # chip; docs/silicon_r03.md). The un-interleave factor is the
+            # weight's own static metadata, not the mesh's tp — a fused-
+            # load/mesh mismatch stays correct (just non-optimally laid
+            # out) instead of silently permuting columns.
+            fw = lp["wqkv"]
+            qkv = _mm(y, fw.weight, "row", mesh)
+            q, k, v = _split_fused(qkv, fw.fuse, fw.dims)
+            q = q.reshape(b, t, h.n_heads, h.head_dim)
+            k = k.reshape(b, t, h.n_kv_heads, h.head_dim)
+            v = v.reshape(b, t, h.n_kv_heads, h.head_dim)
+        else:
+            q = _mm(y, lp["wq"], "row", mesh).reshape(b, t, h.n_heads, h.head_dim)
+            k = _mm(y, lp["wk"], "row", mesh).reshape(b, t, h.n_kv_heads, h.head_dim)
+            v = _mm(y, lp["wv"], "row", mesh).reshape(b, t, h.n_kv_heads, h.head_dim)
         if is_qwen3:
             q = qk_rms_norm(q, lp["q_norm"], h.norm_epsilon)
             k = qk_rms_norm(k, lp["k_norm"], h.norm_epsilon)
@@ -626,10 +660,17 @@ def forward(
             _quantized = isinstance(_w1, QuantWeight)
             _itemsize = 1 if _quantized else _w1.dtype.itemsize
             _f = _w1.q.shape[-1] if _quantized else _w1.shape[-1]
+            # the kernels run PER-SHARD under shard_map, so the VMEM/
+            # tiling gate must see the per-shard F (= F / tp), not the
+            # global one — a shape legal globally can have no Mosaic-legal
+            # F block per shard
             pallas_ok = (
                 h.hidden_act == HiddenAct.SILU
                 and jax.default_backend() == "tpu"
-                and moe_pallas_supported(h.dim, _f, _quantized, _itemsize)
+                and _f % _tp_n == 0
+                and moe_pallas_supported(
+                    h.dim, _f // _tp_n, _quantized, _itemsize
+                )
             )
             if pallas_ok:
                 # decode-sized token counts take the per-(token, choice)
@@ -659,6 +700,13 @@ def forward(
                     h.n_active_experts,
                     act,
                 )
+        elif "w13" in lp:
+            # fused w1|w3: the SwiGLU pair shares its input and activation
+            fw13 = lp["w13"]
+            dl13 = _mm(y, fw13.weight, "row", mesh)
+            d1, l3 = _split_fused(dl13, fw13.fuse, fw13.dims)
+            d = act(d1)
+            f = _mm(d * l3.astype(d.dtype), lp["w2"], "col", mesh, sync_quant)
         else:
             d = act(_mm(y, lp["w1"], "row", mesh))
             l = _mm(y, lp["w3"], "row", mesh)

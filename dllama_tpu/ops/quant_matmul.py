@@ -56,6 +56,30 @@ class QuantWeight(NamedTuple):
         return self.q.shape[-1]
 
 
+@jax.tree_util.register_pytree_node_class
+class FusedQuantWeight:
+    """Several row-split matmul weights fused along the out axis in
+    shard-major interleaved order (models/loader._interleave_concat).
+
+    ``fuse`` (the interleave shard count) and ``dims`` (the constituents'
+    global out dims) ride as STATIC pytree aux data, so the un-interleave
+    factor travels with the weights themselves — consuming fused params on
+    a mesh with a different tp cannot silently mis-permute columns, and
+    `lax.scan` over stacked layers preserves the metadata."""
+
+    def __init__(self, weight: QuantWeight, fuse: int, dims: tuple[int, ...]):
+        self.weight = weight
+        self.fuse = int(fuse)
+        self.dims = tuple(int(d) for d in dims)
+
+    def tree_flatten(self):
+        return (self.weight,), (self.fuse, self.dims)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], *aux)
+
+
 def planar_to_device_layout(
     q_out_in: np.ndarray, d_out_blocks: np.ndarray
 ) -> tuple[np.ndarray, np.ndarray]:

@@ -40,6 +40,11 @@ def param_spec_tree(h: LlmHeader) -> dict[str, Any]:
         "wq": P(None, None, "tp"),
         "wk": P(None, None, "tp"),
         "wv": P(None, None, "tp"),
+        # fused q|k|v / w1|w3 (loader fuse > 0): same row split — the
+        # shard-major interleave makes the contiguous tp chunks each hold
+        # one shard's slice of every constituent
+        "wqkv": P(None, None, "tp"),
+        "w13": P(None, None, "tp"),
         "wo": P(None, "tp", None),
         "w1": row,
         "w2": col,

@@ -211,6 +211,11 @@ class InferenceEngine:
             dtype=dtype,
             put=shard_params_put(self.mesh, self.header),
             weight_format=weight_format,
+            # quantized path: fuse q|k|v (and w1|w3 for dense-FFN archs)
+            # into single shard-major-interleaved kernel launches — 7 -> 4
+            # Pallas calls per decode layer (~41 us fixed cost each,
+            # docs/silicon_r03.md)
+            fuse=tp if weight_format == "q40" else 0,
         )
         # Per-lane serving: lanes park their cache writes in padding rows
         # beyond seqLen while other lanes prefill/idle, so independent

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Serial real-TPU capture: bench presets then validation sweep.
+# One TPU process at a time (the tunnel wedges under concurrency).
+cd /root/repo
+A=artifacts
+for cfg in "llama-1b q40" "llama-1b dense" "llama-8b q40"; do
+  set -- $cfg
+  p=$1; f=$2
+  echo "=== bench $p $f ===" 
+  BENCH_PRESET=$p BENCH_FORMAT=$f timeout 1800 python bench.py \
+    >"$A/bench_${p}_${f}.json" 2>"$A/bench_${p}_${f}.log"
+  echo "exit=$? $(cat $A/bench_${p}_${f}.json)"
+done
+echo "=== tpu_validation ==="
+timeout 2400 python scripts/tpu_validation.py >"$A/tpu_validation_r03.log" 2>&1
+echo "exit=$?"
+tail -30 "$A/tpu_validation_r03.log"

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Focused follow-up sweep for the shipping Q40 kernel (run on silicon).
+
+Round-3's broad sweep (kernel_sweep.py) picked (bn=256, bk=4096) at
+m=1, k=4096, n=14336. This narrows in on what the engine actually
+launches after the qkv/w13 fusion:
+
+  * block-shape neighborhood of the winner,
+  * decode lane counts m in {1, 4, 8, 16} (continuous batching),
+  * the FUSED out dims for the 8B shapes: qkv n=6144 (4096+2*1024),
+    w13 n=28672 (2*14336), wo/w2 shapes,
+  * bf16 scales variant (halves scale bytes; scales are ~2% of traffic
+    so this mostly probes whether the f32->bf16 widening in VMEM costs).
+
+Prints ms/call and effective GB/s per config.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from dllama_tpu.parallel.mesh import enable_compilation_cache, reassert_platform
+
+reassert_platform()
+enable_compilation_cache()
+
+import jax
+import jax.numpy as jnp
+
+from dllama_tpu.ops.quant_matmul import qmatmul_2d
+
+Q_BLOCK = 32
+
+
+def sync(x):
+    return np.asarray(jax.device_get(jnp.ravel(x)[0]))
+
+
+def timeit(f, n_iter=50):
+    o = f()
+    sync(o)
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        o = f()
+    sync(o)
+    return (time.perf_counter() - t0) / n_iter * 1000
+
+
+def main():
+    rng = np.random.default_rng(0)
+    print(f"devices: {jax.devices()}", flush=True)
+
+    # (label, m, k, n) — the 8B decode launches after fusion
+    shapes = [
+        ("qkv-fused 8B", 1, 4096, 6144),
+        ("wo 8B", 1, 4096, 4096),
+        ("w13-fused 8B", 1, 4096, 28672),
+        ("w2 8B", 1, 14336, 4096),
+    ]
+    for m in (4, 8, 16):
+        shapes.append((f"w13-fused 8B m={m}", m, 4096, 28672))
+
+    blocks = [(256, 4096), (128, 4096), (512, 4096), (256, 2048), (256, 8192)]
+
+    for label, m, k, n in shapes:
+        wq = jnp.asarray(rng.integers(-8, 8, size=(k, n), dtype=np.int8))
+        wd = jnp.asarray(
+            rng.standard_normal((k // Q_BLOCK, n)).astype(np.float32) * 0.01
+        )
+        x = jnp.asarray(rng.standard_normal((m, k)).astype(np.float32))
+        nbytes = wq.size + wd.size * 4
+        for bn, bk in blocks:
+            if bk > k:
+                continue
+            try:
+                ms = timeit(
+                    lambda: qmatmul_2d(x, wq, wd, block_n=bn, block_k=bk)
+                )
+            except Exception as e:
+                print(f"{label:22s} bn={bn:5d} bk={bk:5d}  FAIL {type(e).__name__}: {e}",
+                      flush=True)
+                continue
+            gbs = nbytes / (ms / 1000) / 1e9
+            print(f"{label:22s} bn={bn:5d} bk={bk:5d}  {ms:8.3f} ms  {gbs:7.1f} GB/s",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -190,3 +190,37 @@ def test_moe_gather_decode_matches_dense_routing(tmp_path):
     np.testing.assert_allclose(
         np.asarray(full_logits)[0], np.stack(step_logits), rtol=1e-4, atol=1e-4
     )
+
+
+def test_fused_load_no_mesh_matches_unfused(tmp_path):
+    """Params loaded with fuse=2 (tp-interleaved wqkv/w13) run through
+    forward with NO mesh must still match the unfused load bit-for-policy:
+    the un-interleave factor is the FusedQuantWeight's own static
+    metadata, not the mesh's tp, so a fused-load/mesh mismatch cannot
+    mis-permute columns."""
+    path = str(tmp_path / "m.m")
+    make_tiny_model(path, weight_type=FloatType.Q40, seed=5)
+    r = ModelReader(path)
+    p_split = load_params(r, weight_format="q40")
+    p_fused = load_params(r, weight_format="q40", fuse=2)
+    assert "wqkv" in p_fused["layers"] and "w13" in p_fused["layers"]
+    tokens = jnp.asarray([TOKENS], dtype=jnp.int32)
+    lg_s, _ = forward(
+        p_split, r.header, tokens, jnp.int32(0), init_kv_cache(r.header, 1)
+    )
+    lg_f, _ = forward(
+        p_fused, r.header, tokens, jnp.int32(0), init_kv_cache(r.header, 1)
+    )
+    np.testing.assert_allclose(
+        np.asarray(lg_f), np.asarray(lg_s), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_fused_load_indivisible_tp_fails_loudly(tmp_path):
+    """fuse that does not divide a constituent's out dim must raise at
+    load time, not drop trailing columns."""
+    path = str(tmp_path / "m.m")
+    make_tiny_model(path, weight_type=FloatType.Q40, seed=5)
+    r = ModelReader(path)
+    with pytest.raises(ValueError, match="not divisible"):
+        load_params(r, weight_format="q40", fuse=3)  # kv_dim=32 % 3 != 0

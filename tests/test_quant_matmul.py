@@ -147,3 +147,57 @@ def test_moe_active_experts_q40_kernel(m):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(expected), rtol=2e-2, atol=2e-2
     )
+
+
+@pytest.mark.parametrize("tp", [1, 2, 4])
+def test_fused_interleave_roundtrip(tp):
+    """loader._interleave_concat + transformer._split_fused restore the
+    separate matmul outputs (up to XLA reduction-order f32 noise: the
+    fused width changes the einsum's tiling, not its math)."""
+    from dllama_tpu.models.loader import _interleave_concat
+    from dllama_tpu.models.transformer import _split_fused
+
+    rng = np.random.default_rng(7)
+    k = 64
+    dims = (32 * tp, 16 * tp, 16 * tp)
+    ws = [rng.standard_normal((k, d)).astype(np.float32) for d in dims]
+    fused = _interleave_concat(ws, tp)
+    x = jnp.asarray(rng.standard_normal((2, 3, k)).astype(np.float32))
+    out = jnp.einsum("btk,ko->bto", x, jnp.asarray(fused))
+    parts = _split_fused(out, tp, dims)
+    for part, w in zip(parts, ws):
+        expect = jnp.einsum("btk,ko->bto", x, jnp.asarray(w))
+        np.testing.assert_allclose(
+            np.asarray(part), np.asarray(expect), rtol=1e-5, atol=1e-5
+        )
+
+
+def test_fused_quant_loader_matches_split(tmp_path):
+    """Engine-default fusion (weight_format=q40) at the loader level: the
+    fused wqkv QuantWeight dequantizes to the column-permuted concat of
+    wq/wk/wv, and un-interleaving the fused matmul output reproduces the
+    split results (same dequant blocks, f32-noise-level tolerance)."""
+    from dllama_tpu.models.loader import _interleave_concat
+    from dllama_tpu.models.transformer import _split_fused
+
+    tp = 2
+    k = 128
+    dims = (64, 64, 64)
+    qws = [make_qw(d, k, seed=10 + i)[0] for i, d in enumerate(dims)]
+    fused = QuantWeight(
+        jnp.asarray(
+            _interleave_concat([np.asarray(w.q) for w in qws], tp)
+        ),
+        jnp.asarray(
+            _interleave_concat([np.asarray(w.d) for w in qws], tp)
+        ),
+    )
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((1, 1, k)).astype(np.float32))
+    out = qmatmul_ref(x, fused)
+    parts = _split_fused(out, tp, dims)
+    for part, w in zip(parts, qws):
+        expect = qmatmul_ref(x, w)
+        np.testing.assert_allclose(
+            np.asarray(part), np.asarray(expect), rtol=0, atol=1e-5
+        )
