@@ -12,7 +12,7 @@ tokens/s/chip (the Llama-3.3-70B-on-v5e-8 target; BASELINE.json
 expected and self-interpreting; the previous denominator (the reference's
 2.02 tok/s on RPi hardware) flattered every preset and is gone.
 
-Env knobs: BENCH_PRESET (default llama-1b), BENCH_STEPS, BENCH_TP,
+Env knobs: BENCH_PRESET (default llama-8b — the preset closest to the north-star per-chip load), BENCH_STEPS, BENCH_TP,
 BENCH_FORMAT, BENCH_SEQ_LEN, BENCH_SKIP_TTFT.
 """
 
@@ -134,7 +134,7 @@ def main() -> None:
 
     _device_watchdog()
 
-    preset = os.environ.get("BENCH_PRESET", "llama-1b")
+    preset = os.environ.get("BENCH_PRESET", "llama-8b")
     steps = int(os.environ.get("BENCH_STEPS", "64"))
     tp = int(os.environ.get("BENCH_TP", "0")) or 1
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", "1024"))

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Tunnel watcher: probe the TPU every couple of minutes; when it answers,
+# run the post-fusion silicon capture section by section, each in its own
+# subprocess with its own timeout (the tunnel WEDGES rather than errors,
+# so a hang must only cost one section). Results land in artifacts/.
+cd /root/repo
+A=artifacts
+probe() {
+  timeout 150 python -c "
+import jax, jax.numpy as jnp, numpy as np
+x = jnp.ones((256, 256))
+print(float(np.asarray((x @ x).ravel()[0])))
+" >/dev/null 2>&1
+}
+
+until probe; do
+  echo "$(date +%H:%M:%S) tunnel down; retrying in 120s" >&2
+  sleep 120
+done
+echo "$(date +%H:%M:%S) tunnel UP — starting capture" >&2
+
+run() { # name timeout_s cmd...
+  local name=$1 t=$2; shift 2
+  echo "=== $name ==="
+  timeout "$t" "$@" >"$A/$name.log" 2>&1
+  echo "exit=$? (tail):"
+  tail -5 "$A/$name.log"
+}
+
+run bench_8b_q40_fused 1800 env BENCH_PRESET=llama-8b BENCH_FORMAT=q40 python bench.py
+run validate_engine 900 env TPU_VALIDATION_ONLY=engine python scripts/tpu_validation.py
+run sweep_r03b 2400 python scripts/sweep_r03b.py
+run validate_moe 1500 env TPU_VALIDATION_ONLY=moe python scripts/tpu_validation.py
+run bench_1b_q40_fused 900 env BENCH_PRESET=llama-1b BENCH_FORMAT=q40 python bench.py
+echo "=== capture done ==="

@@ -7,6 +7,13 @@ vs dense timing, and decode throughput at 1B/8B. Prints a summary table.
 
     python scripts/tpu_validation.py            # full sweep
     BENCH_QUICK=1 python scripts/tpu_validation.py   # smaller configs
+    TPU_VALIDATION_ONLY=engine,bench python scripts/tpu_validation.py
+
+Sections are INDEPENDENT (qmm, flash, moe, engine, bench) so a flaky
+tunnel can be worked around by running each in its own subprocess with
+its own timeout — a hang in one section (the tunnel wedges rather than
+erroring) no longer forfeits the rest. scripts/silicon_watch.sh does
+exactly that.
 """
 
 from __future__ import annotations
@@ -19,17 +26,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-import jax
-
 from dllama_tpu.parallel.mesh import enable_compilation_cache, reassert_platform
 
 reassert_platform()
 enable_compilation_cache()
 
+import jax
 import jax.numpy as jnp
-from jax import lax
 
 RESULTS: list[tuple[str, str]] = []
+QUICK = bool(os.environ.get("BENCH_QUICK"))
 
 
 def record(name: str, value: str):
@@ -41,16 +47,23 @@ def sync(x):
     return np.asarray(jax.device_get(jnp.ravel(x)[0]))
 
 
-def main() -> None:
-    quick = bool(os.environ.get("BENCH_QUICK"))
-    print(f"devices: {jax.devices()}", flush=True)
+def timeit(f, n_iter=50):
+    o = f()
+    sync(o)
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        o = f()
+    sync(o)
+    return (time.perf_counter() - t0) / n_iter * 1000
 
-    # 1. q40 pallas matmul numerics on silicon
+
+def sec_qmm() -> None:
+    """Q40 pallas matmul numerics on silicon."""
     from dllama_tpu.formats.quants import q40_to_planar, quantize_q40
     from dllama_tpu.ops.quant_matmul import from_planar, qmatmul_2d, qmatmul_ref
 
     rng = np.random.default_rng(0)
-    n, k = (1024, 4096) if quick else (4096, 8192)
+    n, k = (1024, 4096) if QUICK else (4096, 8192)
     w = rng.standard_normal((n, k)).astype(np.float32) * 0.05
     qv, dv = q40_to_planar(quantize_q40(w), n * k)
     qw = from_planar(qv.reshape(n, k), dv.reshape(n, k // 32))
@@ -60,9 +73,12 @@ def main() -> None:
     rel = float(jnp.abs(out - ref).max() / jnp.abs(ref).max())
     record("q40 kernel rel err", f"{rel:.2e} {'OK' if rel < 5e-3 else 'FAIL'}")
 
-    # 2. flash attention numerics on silicon
+
+def sec_flash() -> None:
+    """Flash attention / decode / decode-stats numerics on silicon."""
     from dllama_tpu.ops.flash_attention import attention_ref, flash_attention
 
+    rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((1, 128, 8, 64)).astype(np.float32)).astype(jnp.bfloat16)
     # head-major cache layout [B, KH, S, hd]
     kc = jnp.asarray(rng.standard_normal((1, 4, 1024, 64)).astype(np.float32)).astype(jnp.bfloat16)
@@ -74,19 +90,10 @@ def main() -> None:
     )
     record("flash attn abs err (bf16)", f"{rel:.2e} {'OK' if rel < 2e-2 else 'FAIL'}")
 
-    def timeit(f, n_iter=50):
-        o = f()
-        sync(o)
-        t0 = time.perf_counter()
-        for _ in range(n_iter):
-            o = f()
-        sync(o)
-        return (time.perf_counter() - t0) / n_iter * 1000
-
-    # 2b. flash decode (T=1) numerics + pos-bounded DMA proof
+    # flash decode (T=1) numerics
     from dllama_tpu.ops.flash_attention import flash_decode
 
-    S = 16384 if quick else 32768
+    S = 16384 if QUICK else 32768
     qd = jnp.asarray(rng.standard_normal((1, 1, 8, 64)).astype(np.float32)).astype(jnp.bfloat16)
     kd = jnp.asarray(rng.standard_normal((1, 4, S, 64)).astype(np.float32)).astype(jnp.bfloat16)
     vd = jnp.asarray(rng.standard_normal((1, 4, S, 64)).astype(np.float32)).astype(jnp.bfloat16)
@@ -96,7 +103,7 @@ def main() -> None:
         err = float(jnp.abs(fo.astype(jnp.float32) - fr.astype(jnp.float32)).max())
         record(f"flash decode abs err pos={p}", f"{err:.2e} {'OK' if err < 2e-2 else 'FAIL'}")
 
-    # 2c. flash decode STATS variant (the sp-decode local step) on silicon:
+    # flash decode STATS variant (the sp-decode local step) on silicon:
     # Mosaic lowering of the stats out-specs + the shard-offset clamp only
     # ever runs here before an sp>1 deployment would hit it
     from dllama_tpu.ops.flash_attention import flash_decode_stats
@@ -136,10 +143,19 @@ def main() -> None:
         f"{t_low:.3f} ms vs {t_high:.3f} ms (x{t_high / max(t_low, 1e-9):.1f})",
     )
 
-    # 3. ragged MoE kernel on silicon + timing vs dense
-    from dllama_tpu.ops.moe_kernel import moe_active_experts
 
-    E, D, F, K = (32, 1024, 512, 4) if quick else (128, 2048, 768, 8)
+def sec_moe() -> None:
+    """Ragged + grouped MoE kernels on silicon (dense and q40) + timing."""
+    from dllama_tpu.formats.quants import q40_to_planar, quantize_q40
+    from dllama_tpu.ops.moe_kernel import moe_active_experts
+    from dllama_tpu.ops.quant_matmul import (
+        QuantWeight,
+        dequant as qw_dequant,
+        from_planar,
+    )
+
+    rng = np.random.default_rng(0)
+    E, D, F, K = (32, 1024, 512, 4) if QUICK else (128, 2048, 768, 8)
     w1 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.05).astype(jnp.bfloat16)
     w2 = jnp.asarray(rng.standard_normal((E, F, D)).astype(np.float32) * 0.05).astype(jnp.bfloat16)
     w3 = jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32) * 0.05).astype(jnp.bfloat16)
@@ -163,9 +179,8 @@ def main() -> None:
     rel = float(np.abs(np.asarray(out) - exp).max() / (np.abs(exp).max() + 1e-9))
     record(f"ragged moe rel err (m={M})", f"{rel:.2e} {'OK' if rel < 5e-2 else 'FAIL'}")
 
-    # 3b. quantized ragged MoE kernel on silicon
+    # quantized ragged MoE kernel on silicon
     from dllama_tpu.ops.moe_kernel import moe_active_experts_q40
-    from dllama_tpu.ops.quant_matmul import QuantWeight, dequant as qw_dequant
 
     def quantize_experts(out_dim, in_dim):
         qs, ds = [], []
@@ -190,11 +205,11 @@ def main() -> None:
                 / (np.abs(np.asarray(refq)).max() + 1e-9))
     record("ragged moe q40 rel err", f"{rel:.2e} {'OK' if rel < 5e-2 else 'FAIL'}")
 
-    # 3c. grouped active-expert PREFILL kernel on silicon: numerics vs the
+    # grouped active-expert PREFILL kernel on silicon: numerics vs the
     # dense all-expert einsum at a prefill-scale token count, plus timing
     from dllama_tpu.ops.moe_kernel import moe_grouped_experts
 
-    Np = 64 if quick else 256
+    Np = 64 if QUICK else 256
     xg = jnp.asarray(
         rng.standard_normal((Np, D)).astype(np.float32)
     ).astype(jnp.bfloat16)
@@ -258,7 +273,11 @@ def main() -> None:
     record("moe ragged q40 (full swiglu k experts)", f"{t_ragged_q:.2f} ms")
     record("moe dense (w1 only, all E)", f"{t_dense_w1:.2f} ms")
 
-    # 4. q40 vs dense greedy token parity through the engine (real silicon)
+
+def sec_engine() -> None:
+    """q40-vs-dense greedy token parity + per-lane serving through the
+    actual engine on real silicon (exercises the FUSED wqkv/w13 path —
+    the q40 engine default)."""
     import tempfile
 
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
@@ -272,17 +291,17 @@ def main() -> None:
     make_tiny_model(d + "/m.m", cfg=cfg)
     eq = InferenceEngine(d + "/m.m", tp=1, dtype=jnp.bfloat16, temperature=0.0,
                          weight_format="q40")
+    assert "wqkv" in eq.params["layers"], "q40 engine should fuse by default"
     outq, _, _ = eq.generate([1, 2, 3, 4], max_steps=20)
     del eq
     ed = InferenceEngine(d + "/m.m", tp=1, dtype=jnp.bfloat16, temperature=0.0,
                          weight_format="dense")
     outd, _, _ = ed.generate([1, 2, 3, 4], max_steps=20)
     del ed
-    record("engine q40 == dense tokens", "OK" if outq == outd else f"FAIL {outq} {outd}")
+    record("engine q40(fused) == dense tokens",
+           "OK" if outq == outd else f"FAIL {outq} {outd}")
 
-    # 4b. per-lane serving on silicon: parked prefill + per-lane decode
-    # (the per-lane flash-decode clamp and parked-lane masking lower
-    # through Mosaic for the first time here)
+    # per-lane serving on silicon: parked prefill + per-lane decode
     eb = InferenceEngine(d + "/m.m", tp=1, dtype=jnp.bfloat16,
                          temperature=0.0, weight_format="q40", batch_size=2)
     prompts = [[1, 2, 3, 4], [9, 8, 7, 6, 5]]
@@ -301,13 +320,15 @@ def main() -> None:
     )
     del eb
 
-    # 5. decode throughput
+
+def sec_bench() -> None:
+    """Decode throughput via bench.py subprocesses."""
     import subprocess
 
     env = dict(os.environ)
     for preset, fmt in (
         [("llama-1b", "q40"), ("llama-1b", "dense"), ("llama-8b", "q40")]
-        if not quick
+        if not QUICK
         else [("llama-1b", "q40")]
     ):
         env.update(BENCH_PRESET=preset, BENCH_FORMAT=fmt, BENCH_STEPS="64")
@@ -328,6 +349,24 @@ def main() -> None:
         except subprocess.TimeoutExpired:
             line = "FAIL: timeout (900s)"
         record(f"bench {preset} {fmt}", line)
+
+
+SECTIONS = {
+    "qmm": sec_qmm,
+    "flash": sec_flash,
+    "moe": sec_moe,
+    "engine": sec_engine,
+    "bench": sec_bench,
+}
+
+
+def main() -> None:
+    print(f"devices: {jax.devices()}", flush=True)
+    only = os.environ.get("TPU_VALIDATION_ONLY", "")
+    wanted = [s for s in only.split(",") if s] or list(SECTIONS)
+    for name in wanted:
+        print(f"-- section {name} --", flush=True)
+        SECTIONS[name]()
 
     print("\n=== TPU validation summary ===")
     for name, value in RESULTS:
