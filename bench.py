@@ -13,7 +13,9 @@ expected and self-interpreting; the previous denominator (the reference's
 2.02 tok/s on RPi hardware) flattered every preset and is gone.
 
 Env knobs: BENCH_PRESET (default llama-8b — the preset closest to the north-star per-chip load), BENCH_STEPS, BENCH_TP,
-BENCH_FORMAT, BENCH_SEQ_LEN, BENCH_SKIP_TTFT.
+BENCH_FORMAT, BENCH_SEQ_LEN, BENCH_SKIP_TTFT, BENCH_BATCH (concurrent-lane
+metric, default 4; 0 disables — adds one extra compile + 2x steps of
+batch-N decode to the run).
 """
 
 from __future__ import annotations
@@ -166,11 +168,13 @@ def main() -> None:
     # block of `steps` tokens.
     @partial(jax.jit, donate_argnums=(2,), static_argnums=(3,))
     def decode_block(params, token, cache, n, pos0):
+        # batch-generic (jit specializes per token/cache shape): the same
+        # program serves the single-stream and the concurrent-lane metric
         def body(i, carry):
             tok, cache = carry
             logits, cache = forward(params, h, tok, pos0 + i, cache, mesh=mesh)
             nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-            return nxt.reshape(1, 1), cache
+            return nxt[:, None], cache
         return lax.fori_loop(0, n, body, (token, cache))
 
     token_sharding = NamedSharding(mesh, P(None, None))
@@ -221,6 +225,36 @@ def main() -> None:
         log(f"TTFT (prefill {prompt_len} + 1 token): p50 {ttft_p50:.1f} ms "
             f"(samples: {[f'{s:.0f}' for s in samples]})")
 
+    # concurrent lanes: aggregate decode throughput with BENCH_BATCH
+    # independent streams in one program (the continuous-batching surface
+    # the reference lacks; also exercises the m>1 kernel paths at scale)
+    lanes_tok_s = None
+    n_lanes = int(os.environ.get("BENCH_BATCH", "4"))
+    if n_lanes > 1 and not os.environ.get("BENCH_CPU_FALLBACK"):
+        del cache
+        cache_l = init_kv_cache(h, batch_size=n_lanes, dtype=jnp.bfloat16)
+        cache_l = {
+            k: jax.device_put(v, NamedSharding(mesh, cspecs[k]))
+            for k, v in cache_l.items()
+        }
+
+        tok_l = jax.device_put(
+            jnp.ones((n_lanes, 1), jnp.int32), token_sharding
+        )
+        tok_l, cache_l = decode_block(
+            params, tok_l, cache_l, steps, jnp.int32(0)
+        )
+        _ = np.asarray(tok_l)  # compile + warmup
+        t0 = time.perf_counter()
+        tok_l, cache_l = decode_block(
+            params, tok_l, cache_l, steps, jnp.int32(steps)
+        )
+        _ = np.asarray(tok_l)
+        dt_l = time.perf_counter() - t0
+        lanes_tok_s = n_lanes * steps / dt_l / tp
+        log(f"{n_lanes}-lane decode: {lanes_tok_s:.2f} aggregate tok/s/chip "
+            f"({lanes_tok_s / per_chip:.2f}x single-stream)")
+
     result = {
         "metric": (
             f"decode_tok_s_per_chip_{preset.replace('-', '_')}_{weight_format}"
@@ -234,6 +268,8 @@ def main() -> None:
     }
     if ttft_p50 is not None:
         result["ttft_ms_p50"] = round(ttft_p50, 1)
+    if lanes_tok_s is not None:
+        result[f"lanes{n_lanes}_tok_s_per_chip"] = round(lanes_tok_s, 2)
     print(json.dumps(result))
 
 

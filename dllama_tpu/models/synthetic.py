@@ -165,10 +165,12 @@ def random_params(
         "att_norm": mk("att_norm", L, D, norm=True),
         "ffn_norm": mk("ffn_norm", L, D, norm=True),
         "wo": mm("wo", L, QD, D),
-        # MoE experts stay dense (same policy as the loader)
-        "w1": mk("w1", L, E, D, FF) if moe else mm("w1", L, D, FF),
-        "w2": mk("w2", L, E, FF, D) if moe else mm("w2", L, FF, D),
-        "w3": mk("w3", L, E, D, FF) if moe else mm("w3", L, D, FF),
+        # MoE experts follow the loader's policy: quantized on device for
+        # q40 (the ragged/grouped kernels dequantize selected blocks in
+        # VMEM), dense otherwise
+        "w1": mm("w1", L, E, D, FF) if moe else mm("w1", L, D, FF),
+        "w2": mm("w2", L, E, FF, D) if moe else mm("w2", L, FF, D),
+        "w3": mm("w3", L, E, D, FF) if moe else mm("w3", L, D, FF),
     }
     if quant and fuse:
         # fused-launch layout (loader `fuse`): the content is random either

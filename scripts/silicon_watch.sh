@@ -29,7 +29,9 @@ run() { # name timeout_s cmd...
 
 run bench_8b_q40_fused 1800 env BENCH_PRESET=llama-8b BENCH_FORMAT=q40 python bench.py
 run validate_engine 900 env TPU_VALIDATION_ONLY=engine python scripts/tpu_validation.py
+run validate_qmm_flash 1200 env TPU_VALIDATION_ONLY=qmm,flash python scripts/tpu_validation.py
 run sweep_r03b 2400 python scripts/sweep_r03b.py
 run validate_moe 1500 env TPU_VALIDATION_ONLY=moe python scripts/tpu_validation.py
 run bench_1b_q40_fused 900 env BENCH_PRESET=llama-1b BENCH_FORMAT=q40 python bench.py
+run bench_moe_q40 1800 env BENCH_PRESET=qwen3-30b-a3b BENCH_FORMAT=q40 python bench.py
 echo "=== capture done ==="

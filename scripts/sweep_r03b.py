@@ -52,9 +52,56 @@ def timeit(f, n_iter=50):
     return (time.perf_counter() - t0) / n_iter * 1000
 
 
+def prefill_section(rng):
+    """Prefill path choices, measured (TTFT components):
+
+    (a) q40 Pallas matmul at m=128 vs XLA dense bf16 GEMM on the same
+        (dequantized) weights — at prefill m the matmul is compute-denser
+        and the MXU-optimal dense GEMM may beat the dequant kernel even
+        though it reads ~1.8x the bytes;
+    (b) flash prefill attention vs XLA dense attention at T=128, the
+        default TTFT prompt shape.
+    """
+    from dllama_tpu.ops.flash_attention import attention_ref, flash_attention
+    from dllama_tpu.ops.quant_matmul import QuantWeight, dequant
+
+    k, n = 4096, 14336
+    wq = jnp.asarray(rng.integers(-8, 8, size=(k, n), dtype=np.int8))
+    wd = jnp.asarray(
+        rng.standard_normal((k // Q_BLOCK, n)).astype(np.float32) * 0.01
+    )
+    w_dense = dequant(QuantWeight(wq, wd), jnp.bfloat16)
+    f_dense = jax.jit(lambda xx: xx @ w_dense)
+    for m in (1, 32, 128):
+        x = jnp.asarray(rng.standard_normal((m, k)).astype(np.float32))
+        xb = x.astype(jnp.bfloat16)
+        ms_q = timeit(lambda: qmatmul_2d(x, wq, wd))
+        ms_d = timeit(lambda: f_dense(xb))
+        print(f"prefill matmul m={m:4d}: q40 {ms_q:7.3f} ms  "
+              f"xla-dense-bf16 {ms_d:7.3f} ms", flush=True)
+
+    b, t, s, hq, kh, hd = 1, 128, 2048, 32, 8, 128
+    q = jnp.asarray(
+        rng.standard_normal((b, t, hq, hd)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    kc = jnp.asarray(
+        rng.standard_normal((b, kh, s, hd)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    vc = jnp.asarray(
+        rng.standard_normal((b, kh, s, hd)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    pos = jnp.int32(s - t)
+    ms_f = timeit(lambda: flash_attention(q, kc, vc, pos))
+    f_ref = jax.jit(lambda qq, kk, vv: attention_ref(qq, kk, vv, pos))
+    ms_r = timeit(lambda: f_ref(q, kc, vc))
+    print(f"prefill attn T={t} S={s}: flash {ms_f:7.3f} ms  "
+          f"xla-dense {ms_r:7.3f} ms", flush=True)
+
+
 def main():
     rng = np.random.default_rng(0)
     print(f"devices: {jax.devices()}", flush=True)
+    prefill_section(rng)
 
     # (label, m, k, n) — the 8B decode launches after fusion
     shapes = [

@@ -336,7 +336,7 @@ def sec_bench() -> None:
             r = subprocess.run(
                 [sys.executable,
                  os.path.join(os.path.dirname(__file__), "..", "bench.py")],
-                capture_output=True, text=True, env=env, timeout=900,
+                capture_output=True, text=True, env=env, timeout=1500,
             )
             if r.returncode != 0:
                 line = f"FAIL rc={r.returncode}: {r.stderr.strip().splitlines()[-1] if r.stderr.strip() else 'no stderr'}"
@@ -347,7 +347,7 @@ def sec_bench() -> None:
                     else "no output"
                 )
         except subprocess.TimeoutExpired:
-            line = "FAIL: timeout (900s)"
+            line = "FAIL: timeout (1500s)"
         record(f"bench {preset} {fmt}", line)
 
 
@@ -364,6 +364,12 @@ def main() -> None:
     print(f"devices: {jax.devices()}", flush=True)
     only = os.environ.get("TPU_VALIDATION_ONLY", "")
     wanted = [s for s in only.split(",") if s] or list(SECTIONS)
+    unknown = [s for s in wanted if s not in SECTIONS]
+    if unknown:
+        raise SystemExit(
+            f"unknown TPU_VALIDATION_ONLY section(s) {unknown}; "
+            f"valid: {', '.join(SECTIONS)}"
+        )
     for name in wanted:
         print(f"-- section {name} --", flush=True)
         SECTIONS[name]()
