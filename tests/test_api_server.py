@@ -392,3 +392,41 @@ def test_lane_server_seed_warning(lane_server):
     }) as r:
         body = json.loads(r.read())
     assert "warning" not in body, body
+
+
+def test_chat_completion_q40_fused_engine(tmp_path):
+    """The serving path over a weight_format='q40' engine (which fuses
+    wqkv/w13 by default) must produce the same completion as the dense
+    engine for a greedy request — server x fusion x NaiveCache in one
+    pass."""
+    mp, tp_ = str(tmp_path / "m.m"), str(tmp_path / "t.t")
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=288, seq_len=384)
+    make_tiny_model(mp, weight_type=FloatType.Q40, cfg=cfg)
+    make_tiny_tokenizer(tp_, chat_template="<|start_header_id|>")
+
+    payload = {
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 8,
+        "temperature": 0,
+    }
+    outs = {}
+    for fmt in ("q40", "dense"):
+        tok = Tokenizer(tp_)
+        engine = InferenceEngine(
+            mp, tokenizer=tok, tp=1, dtype=jnp.float32, temperature=0.0,
+            seed=3, weight_format=fmt,
+        )
+        if fmt == "q40":
+            assert "wqkv" in engine.params["layers"]
+            assert "w13" in engine.params["layers"]
+        srv = serve(engine, tok, host="127.0.0.1", port=0)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            with _post(url, payload) as r:
+                outs[fmt] = json.loads(r.read())["choices"][0]["message"]
+        finally:
+            srv.shutdown()
+    assert outs["q40"] == outs["dense"], outs

@@ -286,3 +286,48 @@ def test_lanes_with_sp_mesh(tmp_path):
     )
     outs = esp.generate_batch(prompts, max_steps=16)
     assert outs == singles, (outs, singles)
+
+
+def test_qmatmul_tp_row_fused_shard_map(monkeypatch):
+    """The 'row' shard_map branch over a FUSED shard-major-interleaved
+    weight: each tp shard must receive its own q|k|v slice and the
+    un-interleave must restore the split results. Off-TPU the dispatcher
+    bypasses shard_map, so force it (Pallas entry stubbed with the
+    reference matmul — the wiring under test is the partitioning)."""
+    from dllama_tpu.ops import quant_matmul as qm
+    from dllama_tpu.formats.quants import q40_to_planar, quantize_q40
+    from dllama_tpu.models.loader import _interleave_concat
+    from dllama_tpu.models.transformer import _split_fused
+
+    monkeypatch.setattr(qm, "_use_pallas", lambda: True)
+    monkeypatch.setattr(
+        qm, "qmatmul", lambda x, w, block_n=256: qm.qmatmul_ref(x, w)
+    )
+
+    rng = np.random.default_rng(44)
+    tp, k_dim = 2, 128
+    dims = (64, 32, 32)
+
+    def qw_for(n_dim, seed):
+        r = np.random.default_rng(seed)
+        w = r.standard_normal((n_dim, k_dim)).astype(np.float32) * 0.1
+        qv, dv = q40_to_planar(quantize_q40(w), n_dim * k_dim)
+        return qm.from_planar(
+            qv.reshape(n_dim, k_dim), dv.reshape(n_dim, k_dim // 32)
+        )
+
+    qws = [qw_for(d, 50 + i) for i, d in enumerate(dims)]
+    fused = qm.QuantWeight(
+        jnp.asarray(_interleave_concat([np.asarray(w.q) for w in qws], tp)),
+        jnp.asarray(_interleave_concat([np.asarray(w.d) for w in qws], tp)),
+    )
+    x = jnp.asarray(rng.standard_normal((1, 1, k_dim)).astype(np.float32))
+    mesh = make_mesh(tp=tp)
+
+    out = qm.qmatmul_tp(x, fused, "row", mesh)
+    parts = _split_fused(out, tp, dims)
+    for part, w in zip(parts, qws):
+        expect = qm.qmatmul_tp(x, w, "row", mesh)
+        np.testing.assert_allclose(
+            np.asarray(part), np.asarray(expect), rtol=1e-5, atol=1e-5
+        )
