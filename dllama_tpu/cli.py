@@ -52,6 +52,12 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                    help="sequence-parallel chips: shard the KV cache's "
                         "sequence axis for long contexts (ring prefill + "
                         "merged-stats decode); total chips = tp x sp")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages: each holds nLayers/pp layers + "
+                        "that range's KV cache — fits models past the "
+                        "tp <= nKvHeads ceiling; composes with "
+                        "--batch-size lanes (tp/sp composition is "
+                        "future work)")
     p.add_argument("--workers", nargs="*", default=None, help="alias for --tp: pass a chip count (host:port lists are a LAN-cluster concept)")
     p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--kv-dtype", default=None, choices=[None, "bf16", "f32"])
@@ -114,6 +120,9 @@ def load_engine(args):
     tok = Tokenizer(args.tokenizer)
     tp = _resolve_tp(args)
     sp = getattr(args, "sp", 1) or 1
+    pp = getattr(args, "pp", 1) or 1
+    if pp > 1 and tp == 0:
+        tp = 1  # pp is exclusive with tp for now; don't auto-expand tp
     if tp == 0:
         from .parallel.mesh import auto_tp
 
@@ -128,6 +137,7 @@ def load_engine(args):
         tokenizer=tok,
         tp=tp,
         sp=sp,
+        pp=pp,
         dtype=dtype,
         kv_dtype=kv_dtype,
         max_seq_len=args.max_seq_len,

@@ -565,34 +565,89 @@ def forward(
     1B/128k-vocab shape), which lands directly on TTFT.
     """
     b, t = tokens.shape
-    # mesh tp size: per-shard shape checks (MoE kernel gate)
-    _tp_n = mesh.shape.get("tp", 1) if mesh is not None else 1
-    interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
-    act = silu if h.hidden_act == HiddenAct.SILU else gelu
-    is_qwen3 = h.arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE)
     # `pos` may be a [B] vector: each batch lane decodes at its own
     # position (independent request lanes — the continuous-batching
     # surface the reference's single-stream loop lacks)
-    per_lane = jnp.ndim(pos) == 1
-    if per_lane and attn_park_threshold:
-        # parked lanes: writes at `pos`, attention masked out (see above).
-        # The sentinel must stay negative for every query row of a T-wide
-        # chunk, hence -(cache length).
-        attn_pos = jnp.where(
-            pos >= attn_park_threshold, -cache["k"].shape[3], pos
-        )
-    else:
-        attn_pos = pos
+    attn_pos = attn_positions(pos, attn_park_threshold, cache["k"].shape[3])
 
     x = params["embed"][tokens]  # [B, T, D] (reference: OP_EMBEDDING)
 
-    if per_lane:
+    cos, sin = rope_slices(params, pos, t)
+    x, k_new, v_new = run_layers(
+        x, params["layers"], cache["k"], cache["v"], h, pos, attn_pos,
+        cos, sin, mesh=mesh, attn_window=attn_window,
+        sync_quant=sync_quant, moe_gather_max_tokens=moe_gather_max_tokens,
+    )
+    logits = logits_head(x, params, h, mesh, logits_mode)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def attn_positions(pos, attn_park_threshold: int, cache_len: int):
+    """Attention-query positions from cache-write positions: per-lane
+    vectors with a park threshold mask parked lanes out of attention
+    entirely (sentinel strongly negative for every query row of a T-wide
+    chunk, hence -cache_len). Shared by `forward` and the pipeline driver
+    so the park semantics cannot drift between them."""
+    if jnp.ndim(pos) == 1 and attn_park_threshold:
+        return jnp.where(pos >= attn_park_threshold, -cache_len, pos)
+    return pos
+
+
+def rope_slices(params: Params, pos: jnp.ndarray, t: int):
+    """cos/sin rows for a T-wide chunk at `pos` (scalar, or [B] per-lane
+    positions -> per-lane gathered [B, T, hd/2] tables)."""
+    if jnp.ndim(pos) == 1:
         positions = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
-        cos = params["rope_cos"][positions]  # [B, T, hd/2]
-        sin = params["rope_sin"][positions]
-    else:
-        cos = lax.dynamic_slice_in_dim(params["rope_cos"], pos, t, axis=0)
-        sin = lax.dynamic_slice_in_dim(params["rope_sin"], pos, t, axis=0)
+        return params["rope_cos"][positions], params["rope_sin"][positions]
+    cos = lax.dynamic_slice_in_dim(params["rope_cos"], pos, t, axis=0)
+    sin = lax.dynamic_slice_in_dim(params["rope_sin"], pos, t, axis=0)
+    return cos, sin
+
+
+def logits_head(x, params: Params, h: LlmHeader, mesh, logits_mode: str):
+    """Final norm + vocab matmul (reference: src/llm.cpp:560-599)."""
+    if logits_mode not in ("all", "last"):
+        raise ValueError(f"unknown logits_mode: {logits_mode!r}")
+    if logits_mode == "last":
+        x = x[:, -1:, :]
+    y = rms_norm(x, params["final_norm"], h.norm_epsilon)
+    wcls = params["wcls"]
+    if isinstance(wcls, QuantWeight):
+        return qmatmul_tp(y, wcls, "row", mesh)
+    return jnp.einsum(
+        "btd,dv->btv", y.astype(jnp.float32), wcls.astype(jnp.float32)
+    )
+
+
+def run_layers(
+    x: jnp.ndarray,  # [B, T, D]
+    layers: Params,  # stacked per-layer params, [L, ...] leading axis
+    k_cache: jnp.ndarray,  # [L, B, KH, S, hd]
+    v_cache: jnp.ndarray,
+    h: LlmHeader,
+    pos: jnp.ndarray,  # scalar or [B]: cache-write positions
+    attn_pos: jnp.ndarray,  # same, possibly park-masked (see forward)
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    mesh=None,
+    attn_window: int = 0,
+    sync_quant: bool = False,
+    moe_gather_max_tokens: int = 0,
+):
+    """`lax.scan` the decoder layers over x; returns (x, k_new, v_new).
+
+    Factored out of `forward` so the pipeline-parallel driver
+    (parallel/pipeline.py) can run a STAGE'S LOCAL layer slice with
+    identical math — there `layers`/caches carry L/pp layers and
+    mesh=None (each stage computes locally; activations ride ppermute).
+    """
+    b, t = x.shape[0], x.shape[1]
+    interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
+    act = silu if h.hidden_act == HiddenAct.SILU else gelu
+    is_qwen3 = h.arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE)
+    per_lane = jnp.ndim(pos) == 1
+    # mesh tp size: per-shard shape checks (MoE kernel gate)
+    _tp_n = mesh.shape.get("tp", 1) if mesh is not None else 1
 
     def _cache_append(cache_l, val):
         """Write the chunk at each lane's position (reference: OP_SHIFT,
@@ -715,20 +770,6 @@ def forward(
         return x, (k_cache_l, v_cache_l)
 
     x, (k_new, v_new) = lax.scan(
-        layer_step, x, (params["layers"], cache["k"], cache["v"])
+        layer_step, x, (layers, k_cache, v_cache)
     )
-
-    # final norm + logits (reference: src/llm.cpp:560-599)
-    if logits_mode not in ("all", "last"):
-        raise ValueError(f"unknown logits_mode: {logits_mode!r}")
-    if logits_mode == "last":
-        x = x[:, -1:, :]
-    y = rms_norm(x, params["final_norm"], h.norm_epsilon)
-    wcls = params["wcls"]
-    if isinstance(wcls, QuantWeight):
-        logits = qmatmul_tp(y, wcls, "row", mesh)
-    else:
-        logits = jnp.einsum(
-            "btd,dv->btv", y.astype(jnp.float32), wcls.astype(jnp.float32)
-        )
-    return logits, {"k": k_new, "v": v_new}
+    return x, k_new, v_new

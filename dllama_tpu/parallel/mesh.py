@@ -101,25 +101,33 @@ def auto_tp(model_path: str, n_devices: int | None = None) -> int:
     return tp
 
 
-def make_mesh(tp: int = 1, dp: int = 1, sp: int = 1, devices=None) -> Mesh:
-    """Build a (dp, sp, tp) mesh over the available devices.
+def make_mesh(
+    tp: int = 1, dp: int = 1, sp: int = 1, pp: int = 1, devices=None
+) -> Mesh:
+    """Build a (pp, dp, sp, tp) mesh over the available devices.
 
-    `sp` is the sequence/context-parallel axis (ring attention); the sp
-    dimension only appears in the mesh when > 1 so existing (dp, tp)
-    PartitionSpecs stay valid. Uses `jax.experimental.mesh_utils` device
-    ordering so the tp axis maps to physically adjacent chips (fastest ICI
-    hops) on real TPU slices.
+    `sp` is the sequence/context-parallel axis (ring attention); `pp` the
+    pipeline-stage axis (layer ranges per stage, parallel/pipeline.py —
+    the axis that lifts the reference's nNodes <= nKvHeads ceiling on
+    cluster size). Each axis only appears in the mesh when > 1 so
+    existing PartitionSpecs stay valid. Uses `jax.experimental.mesh_utils`
+    device ordering so the tp axis maps to physically adjacent chips
+    (fastest ICI hops) on real TPU slices; pp is outermost — stage
+    hand-offs are the rarest, smallest transfers.
     """
     if devices is None:
         devices = jax.devices()
-    n_needed = tp * dp * sp
+    n_needed = tp * dp * sp * pp
     if n_needed > len(devices):
         raise ValueError(
-            f"need {n_needed} devices (tp={tp} x dp={dp} x sp={sp}), "
-            f"have {len(devices)}"
+            f"need {n_needed} devices (pp={pp} x tp={tp} x dp={dp} x "
+            f"sp={sp}), have {len(devices)}"
         )
     shape = (dp, sp, tp) if sp > 1 else (dp, tp)
     names = ("dp", "sp", "tp") if sp > 1 else ("dp", "tp")
+    if pp > 1:
+        shape = (pp,) + shape
+        names = ("pp",) + names
     try:
         from jax.experimental import mesh_utils
 

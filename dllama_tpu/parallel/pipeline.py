@@ -1,0 +1,143 @@
+"""Pipeline parallelism: decoder layers sharded into stages over a `pp`
+mesh axis.
+
+The reference cannot scale past `nNodes <= nKvHeads` — its only cross-node
+strategy is tensor parallelism, bounded by the KV-head count (SURVEY.md §2
+parallelism checklist; src/app.cpp:236-240). Pipeline stages lift that
+ceiling: each stage holds a contiguous range of L/pp layers (weights AND
+that range's KV cache), activations hop stage-to-stage over ICI
+(`lax.ppermute` of one [B, T, D] tensor — the smallest inter-chip payload
+in the whole model), and the per-stage HBM footprint shrinks by pp. A
+70B+ checkpoint that cannot fit tp<=8 chips runs as pp stages of tp
+groups.
+
+Schedule (inference forward, single microbatch): P pipeline ticks; at
+tick i stage i runs its local layer scan on the activation it received,
+every other stage computes the same program on pass-through data and
+discards it (SPMD requires identical programs; the discarded compute is
+the classic pipeline bubble). Latency per forward is the same L layer
+steps the single-device program pays — the bubble costs device
+*utilization*, not request latency, so for fit-constrained serving the
+trade is free. Stage-local math is `models.transformer.run_layers` —
+bit-identical to the single-device path.
+
+Caches: the [L, ...] KV cache shards its LAYER axis over pp (each stage
+owns its range's cache); a stage's cache only commits on its active tick.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..formats.model_file import LlmHeader
+
+
+def validate_pp(h: LlmHeader, pp: int) -> None:
+    if pp < 1 or (pp & (pp - 1)) != 0:
+        raise ValueError(f"pp must be a power of two, got {pp}")
+    if pp > 1 and h.n_layers % pp != 0:
+        raise ValueError(
+            f"nLayers={h.n_layers} not divisible by pp={pp} (stages hold "
+            "equal layer ranges)"
+        )
+
+
+def pp_param_specs(base: dict) -> dict:
+    """Layer-stacked params shard the stage axis on their leading (layer)
+    dim; global tensors (embed, wcls, norms, rope) stay replicated on pp.
+    `base` is parallel.sharding.param_spec_tree output (tp rules), whose
+    layers-tree specs lead with the layer axis (None or empty = the
+    replicated norms) — pp takes that axis over."""
+
+    def with_pp(spec):
+        tup = tuple(spec)
+        if not tup:
+            return P("pp")
+        if tup[0] is not None:
+            raise ValueError(
+                f"layers leaf's leading (layer) axis is already sharded "
+                f"({spec}); pp cannot take it over"
+            )
+        return P(*(("pp",) + tup[1:]))
+
+    out = dict(base)
+    out["layers"] = {k: with_pp(spec) for k, spec in base["layers"].items()}
+    return out
+
+
+def forward_pp(
+    params,
+    h: LlmHeader,
+    tokens: jnp.ndarray,  # [B, T] int32
+    pos: jnp.ndarray,  # scalar or [B]
+    cache,  # {"k","v"}: [L, B, KH, S, hd], layer axis pp-sharded
+    mesh,
+    attn_window: int = 0,
+    attn_park_threshold: int = 0,
+    logits_mode: str = "all",
+):
+    """Pipeline-parallel forward: same contract as models.forward.
+
+    Stage-local compute runs with mesh=None (plain kernels, no nested
+    shard_map); tp/sp composition inside a stage is future work — the
+    engine currently accepts pp with tp=sp=dp=1.
+    """
+    from jax import shard_map
+
+    from ..models.transformer import (
+        attn_positions,
+        logits_head,
+        rope_slices,
+        run_layers,
+    )
+
+    pp = mesh.shape["pp"]
+    t = tokens.shape[1]
+    attn_pos = attn_positions(pos, attn_park_threshold, cache["k"].shape[3])
+
+    layers = params["layers"]
+    globals_ = {
+        k: params[k]
+        for k in ("embed", "wcls", "final_norm", "rope_cos", "rope_sin")
+    }
+
+    stage_spec = P("pp")  # prefix spec: leading (layer) axis of every leaf
+    repl = P()
+
+    def body(layers, k_c, v_c, globals_, tokens, pos, attn_pos):
+        stage = lax.axis_index("pp")
+        cos, sin = rope_slices(globals_, pos, t)
+        x = globals_["embed"][tokens]  # [B, T, D]
+        for tick in range(pp):
+            x_out, k_new, v_new = run_layers(
+                x, layers, k_c, v_c, h, pos, attn_pos, cos, sin,
+                mesh=None, attn_window=attn_window,
+            )
+            active = stage == tick
+            # commit this stage's cache range only on its active tick;
+            # inactive ticks computed on pass-through data
+            k_c = jnp.where(active, k_new, k_c)
+            v_c = jnp.where(active, v_new, v_c)
+            x = jnp.where(active, x_out, x)
+            # hand the activation to the next stage; after the last tick
+            # this rotates the final stage's result onto stage 0
+            x = lax.ppermute(
+                x, "pp", [(i, (i + 1) % pp) for i in range(pp)]
+            )
+        # broadcast stage 0's (final) activation to all stages, then every
+        # stage computes the replicated logits head
+        x = lax.psum(jnp.where(stage == 0, x, jnp.zeros_like(x)), "pp")
+        logits = logits_head(x, globals_, h, None, logits_mode)
+        return logits, k_c, v_c
+
+    logits, k_new, v_new = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(stage_spec, stage_spec, stage_spec, repl, repl, repl, repl),
+        out_specs=(repl, stage_spec, stage_spec),
+        check_vma=False,
+    )(layers, cache["k"], cache["v"], globals_, tokens, pos, attn_pos)
+    return logits, {"k": k_new, "v": v_new}

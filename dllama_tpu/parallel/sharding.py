@@ -68,14 +68,19 @@ def param_spec_tree(h: LlmHeader) -> dict[str, Any]:
     }
 
 
-def cache_specs(h: LlmHeader, sp: bool = False) -> dict[str, P]:
+def cache_specs(h: LlmHeader, sp: bool = False, pp: bool = False) -> dict[str, P]:
     """KV cache [L, B, KH, S, hd] (head-major): batch over dp, kv-heads
     over tp (reference: sliceKvCache, src/nn/nn-core.cpp:211-218). With
     `sp` the sequence axis additionally shards over the sp mesh axis — the
     long-context layout ring/merged attention consumes
-    (models/transformer._attention_sp)."""
+    (models/transformer._attention_sp). With `pp` the LAYER axis shards
+    over pipeline stages (each stage owns its layer range's cache,
+    parallel/pipeline.py)."""
+    lead = "pp" if pp else None
     spec = (
-        P(None, "dp", "tp", "sp", None) if sp else P(None, "dp", "tp", None, None)
+        P(lead, "dp", "tp", "sp", None)
+        if sp
+        else P(lead, "dp", "tp", None, None)
     )
     return {"k": spec, "v": spec}
 
@@ -89,8 +94,13 @@ def shard_params_put(mesh: Mesh, h: LlmHeader):
     with its TP sharding as it is read — per-shard streaming, so host
     memory and per-device HBM stay at one slice per tensor (the TPU
     equivalent of the reference's slice-by-slice socket streaming,
-    src/llm.cpp:614-669)."""
+    src/llm.cpp:614-669). On a mesh with a `pp` axis the layer-stacked
+    tensors additionally shard their leading (layer) axis over stages."""
     specs = param_spec_tree(h)
+    if "pp" in mesh.axis_names:
+        from .pipeline import pp_param_specs
+
+        specs = pp_param_specs(specs)
     flat_layer_specs = specs["layers"]
 
     def put(name: str, arr: np.ndarray):

@@ -127,6 +127,7 @@ class InferenceEngine:
         tp: int = 1,
         dp: int = 1,
         sp: int = 1,
+        pp: int = 1,
         dtype=jnp.bfloat16,
         kv_dtype=None,
         max_seq_len: int = 0,
@@ -152,8 +153,19 @@ class InferenceEngine:
             raise ValueError(
                 f"seqLen {self.header.seq_len} not divisible by sp={sp}"
             )
-        self.mesh = make_mesh(tp=tp, dp=dp, sp=sp)
-        self.tp, self.dp, self.sp = tp, dp, sp
+        # pipeline stages: layer ranges per stage (parallel/pipeline.py) —
+        # the capacity axis past the reference's nNodes <= nKvHeads bound.
+        # Stage-local tp/sp composition is future work.
+        from ..parallel.pipeline import validate_pp
+
+        validate_pp(self.header, pp)
+        if pp > 1 and (tp > 1 or dp > 1 or sp > 1):
+            raise ValueError(
+                "pp currently composes with tp=dp=sp=1 (stage-local "
+                "tensor/sequence splits are future work)"
+            )
+        self.mesh = make_mesh(tp=tp, dp=dp, sp=sp, pp=pp)
+        self.tp, self.dp, self.sp, self.pp = tp, dp, sp, pp
         self.batch_size = batch_size
         self.dtype = dtype
         self.kv_dtype = kv_dtype or dtype
@@ -230,13 +242,47 @@ class InferenceEngine:
         self._park = self.header.seq_len  # first padding row
         self._cache_sharding = {
             k: NamedSharding(self.mesh, spec)
-            for k, spec in cache_specs(self.header, sp=sp > 1).items()
+            for k, spec in cache_specs(
+                self.header, sp=sp > 1, pp=pp > 1
+            ).items()
         }
         self.cache = self._fresh_cache()
-        self._token_sharding = NamedSharding(self.mesh, P("dp", None))
+        self._token_sharding = NamedSharding(
+            self.mesh, P("dp", None) if pp == 1 else P(None, None)
+        )
         self._compiled = {}
         self._base_key = jax.random.PRNGKey(seed)
         self._rng_calls = 0
+
+        # unified forward dispatch: every compiled step goes through this,
+        # so the pipeline schedule slots under the SAME bucketed prefill /
+        # block decode / lane machinery as the flat mesh
+        h = self.header
+        mesh = self.mesh
+        sync_quant = self._sync_quant
+        if pp > 1:
+            from ..parallel.pipeline import forward_pp
+
+            def fwd(params, tokens, pos, cache, *, attn_window=0,
+                    logits_mode="all", attn_park_threshold=0):
+                return forward_pp(
+                    params, h, tokens, pos, cache, mesh,
+                    attn_window=attn_window, logits_mode=logits_mode,
+                    attn_park_threshold=attn_park_threshold,
+                )
+
+        else:
+
+            def fwd(params, tokens, pos, cache, *, attn_window=0,
+                    logits_mode="all", attn_park_threshold=0):
+                return forward(
+                    params, h, tokens, pos, cache, mesh=mesh,
+                    attn_window=attn_window, logits_mode=logits_mode,
+                    attn_park_threshold=attn_park_threshold,
+                    sync_quant=sync_quant,
+                )
+
+        self._fwd = fwd
 
     # -- cache ---------------------------------------------------------------
 
@@ -294,10 +340,8 @@ class InferenceEngine:
         key = (t, greedy, window)
         if key in self._compiled:
             return self._compiled[key]
-        h = self.header
         precision = self._precision
-
-        mesh = self.mesh
+        fwd = self._fwd
 
         @partial(jax.jit, donate_argnums=(2,))
         def step(params, tokens, cache, pos):
@@ -307,10 +351,9 @@ class InferenceEngine:
                 else contextlib.nullcontext()
             )
             with ctx:
-                logits, cache = forward(
-                    params, h, tokens, pos, cache, mesh=mesh,
+                logits, cache = fwd(
+                    params, tokens, pos, cache,
                     attn_window=window, logits_mode="last",
-                    sync_quant=self._sync_quant,
                 )
             last = logits[:, -1, :]
             if greedy:
@@ -334,9 +377,8 @@ class InferenceEngine:
         key = ("block", n_steps, greedy, window)
         if key in self._compiled:
             return self._compiled[key]
-        h = self.header
-        mesh = self.mesh
         precision = self._precision
+        fwd = self._fwd
 
         @partial(jax.jit, donate_argnums=(2,))
         def block(params, token, cache, pos, rng, temperature, topp):
@@ -348,10 +390,9 @@ class InferenceEngine:
                     else contextlib.nullcontext()
                 )
                 with ctx:
-                    logits, cache = forward(
-                        params, h, tok, pos + i, cache, mesh=mesh,
+                    logits, cache = fwd(
+                        params, tok, pos + i, cache,
                         attn_window=window, logits_mode="last",
-                        sync_quant=self._sync_quant,
                     )
                 last = logits[:, -1, :]
                 if greedy:
@@ -425,9 +466,8 @@ class InferenceEngine:
         key = ("score", t, window)
         if key in self._compiled:
             return self._compiled[key]
-        h = self.header
-        mesh = self.mesh
         precision = self._precision
+        fwd = self._fwd
 
         @partial(jax.jit, donate_argnums=(4,))
         def score(params, tokens, targets, mask, cache, pos):
@@ -437,9 +477,8 @@ class InferenceEngine:
                 else contextlib.nullcontext()
             )
             with ctx:
-                logits, cache = forward(
-                    params, h, tokens, pos, cache, mesh=mesh,
-                    attn_window=window, sync_quant=self._sync_quant,
+                logits, cache = fwd(
+                    params, tokens, pos, cache, attn_window=window,
                 )
             lg = logits.astype(jnp.float32)  # [B, T, V]
             lse = jax.nn.logsumexp(lg, axis=-1)  # [B, T]
@@ -529,10 +568,8 @@ class InferenceEngine:
         key = ("lane_prefill", t, window)
         if key in self._compiled:
             return self._compiled[key]
-        h = self.header
-        mesh = self.mesh
         precision = self._precision
-
+        fwd = self._fwd
         park = self._park
 
         @partial(jax.jit, donate_argnums=(2,))
@@ -543,10 +580,10 @@ class InferenceEngine:
                 else contextlib.nullcontext()
             )
             with ctx:
-                _, cache = forward(
-                    params, h, tokens, pos_vec, cache, mesh=mesh,
+                _, cache = fwd(
+                    params, tokens, pos_vec, cache,
                     attn_window=window, attn_park_threshold=park,
-                    logits_mode="last", sync_quant=self._sync_quant,
+                    logits_mode="last",
                 )
             return cache
 
@@ -605,9 +642,8 @@ class InferenceEngine:
         key = ("lane_block", n_steps, window)
         if key in self._compiled:
             return self._compiled[key]
-        h = self.header
-        mesh = self.mesh
         precision = self._precision
+        fwd = self._fwd
         park = self._park
 
         seq_len = self.header.seq_len
@@ -631,11 +667,10 @@ class InferenceEngine:
                     else contextlib.nullcontext()
                 )
                 with ctx:
-                    logits, cache = forward(
-                        params, h, tok, cur, cache, mesh=mesh,
+                    logits, cache = fwd(
+                        params, tok, cur, cache,
                         attn_window=window,
                         attn_park_threshold=park, logits_mode="last",
-                        sync_quant=self._sync_quant,
                     )
                 last = logits[:, -1, :]
                 nxt = _sample_on_device(

@@ -1,0 +1,149 @@
+"""Pipeline parallelism: forward_pp vs the single-device forward.
+
+The reference has no pipeline strategy (SURVEY.md §2 checklist: TP only,
+bounded by nNodes <= nKvHeads); these tests pin the pp stage schedule —
+identical logits AND identical per-layer cache commits — on the virtual
+CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.formats import FloatType, ModelReader
+from dllama_tpu.models import forward, init_kv_cache, load_params
+from dllama_tpu.parallel.mesh import make_mesh
+from dllama_tpu.parallel.pipeline import forward_pp, validate_pp
+
+from helpers import make_tiny_model
+
+CFG4 = dict(dim=64, hidden_dim=160, n_layers=4, n_heads=4, n_kv_heads=2,
+            head_dim=16, vocab_size=256, seq_len=64)
+TOKENS = [3, 17, 92, 5, 44, 120, 7, 3]
+
+
+def _params(tmp_path, weight_format="dense", fuse=0):
+    path = str(tmp_path / "m.m")
+    make_tiny_model(path, weight_type=FloatType.Q40, seed=11, cfg=CFG4)
+    r = ModelReader(path)
+    p = load_params(r, weight_format=weight_format, fuse=fuse)
+    return r.header, p
+
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_forward_pp_matches_single(tmp_path, pp):
+    h, params = _params(tmp_path)
+    mesh = make_mesh(pp=pp)
+    tokens = jnp.asarray([TOKENS], jnp.int32)
+
+    lg_ref, cache_ref = forward(
+        params, h, tokens, jnp.int32(0), init_kv_cache(h, 1)
+    )
+    lg_pp, cache_pp = forward_pp(
+        params, h, tokens, jnp.int32(0), init_kv_cache(h, 1), mesh
+    )
+    np.testing.assert_allclose(
+        np.asarray(lg_pp), np.asarray(lg_ref), rtol=1e-5, atol=1e-5
+    )
+    for k in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(cache_pp[k]), np.asarray(cache_ref[k]),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+def test_forward_pp_decode_chain(tmp_path):
+    """Greedy prefill + 6 decode steps through forward_pp must reproduce
+    the single-device token stream (cache committed per stage range)."""
+    h, params = _params(tmp_path)
+    mesh = make_mesh(pp=2)
+    prompt = TOKENS[:4]
+
+    def run(fwd, **kw):
+        cache = init_kv_cache(h, 1)
+        toks = jnp.asarray([prompt], jnp.int32)
+        logits, cache = fwd(params, h, toks, jnp.int32(0), cache, **kw)
+        out = [int(jnp.argmax(logits[0, -1]))]
+        pos = len(prompt)
+        for _ in range(6):
+            logits, cache = fwd(
+                params, h, jnp.asarray([[out[-1]]], jnp.int32),
+                jnp.int32(pos), cache, **kw,
+            )
+            out.append(int(jnp.argmax(logits[0, -1])))
+            pos += 1
+        return out
+
+    expected = run(forward)
+    got = run(forward_pp, mesh=mesh)
+    assert got == expected, (got, expected)
+
+
+def test_forward_pp_q40_fused(tmp_path):
+    """Quantized weights with fused wqkv/w13 run stage-local inside the pp
+    shard_map (mesh=None per stage -> local qmatmul) and match dense."""
+    h, pq = _params(tmp_path, weight_format="q40", fuse=1)
+    mesh = make_mesh(pp=2)
+    tokens = jnp.asarray([TOKENS], jnp.int32)
+    lg_ref, _ = forward(pq, h, tokens, jnp.int32(0), init_kv_cache(h, 1))
+    lg_pp, _ = forward_pp(pq, h, tokens, jnp.int32(0), init_kv_cache(h, 1), mesh)
+    np.testing.assert_allclose(
+        np.asarray(lg_pp), np.asarray(lg_ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_validate_pp(tmp_path):
+    h, _ = _params(tmp_path)
+    validate_pp(h, 2)
+    validate_pp(h, 4)
+    with pytest.raises(ValueError, match="power of two"):
+        validate_pp(h, 3)
+    with pytest.raises(ValueError, match="not divisible"):
+        validate_pp(h, 8)  # 4 layers / 8 stages
+
+
+def test_engine_pp_matches_single_device(tmp_path):
+    """The full engine path (bucketed prefill + on-device block decode)
+    over pp=2 stages must reproduce the single-device token stream, for
+    dense AND fused-q40 weights."""
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    path = str(tmp_path / "m.m")
+    make_tiny_model(path, weight_type=FloatType.Q40, seed=11, cfg=CFG4)
+    prompt = [1, 2, 3, 4, 5]
+    for fmt in ("dense", "q40"):
+        e1 = InferenceEngine(
+            path, tp=1, dtype=jnp.float32, temperature=0.0, weight_format=fmt
+        )
+        expected, _, _ = e1.generate(prompt, max_steps=16)
+        del e1
+        epp = InferenceEngine(
+            path, pp=2, dtype=jnp.float32, temperature=0.0, weight_format=fmt
+        )
+        assert epp.mesh.shape["pp"] == 2
+        got, _, _ = epp.generate(prompt, max_steps=16)
+        del epp
+        assert got == expected, (fmt, got, expected)
+
+
+def test_engine_pp_with_lanes(tmp_path):
+    """Continuous batching over pipeline stages: per-lane prefill+decode
+    with pp=2 must reproduce each prompt's single-stream tokens (parked
+    writes and per-lane positions flow through the stage schedule)."""
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    path = str(tmp_path / "m.m")
+    make_tiny_model(path, weight_type=FloatType.Q40, seed=11, cfg=CFG4)
+    prompts = [[1, 2, 3, 4], [9, 8, 7, 6, 5]]
+    singles = []
+    e1 = InferenceEngine(path, tp=1, dtype=jnp.float32, temperature=0.0)
+    for p in prompts:
+        e1.reset()
+        o, _, _ = e1.generate(p, max_steps=16)
+        singles.append(o)
+    del e1
+    epp = InferenceEngine(
+        path, pp=2, dtype=jnp.float32, temperature=0.0, batch_size=2
+    )
+    outs = epp.generate_batch(prompts, max_steps=16)
+    assert outs == singles, (outs, singles)
