@@ -36,8 +36,11 @@ from ..formats.model_file import LlmHeader
 
 
 def validate_pp(h: LlmHeader, pp: int) -> None:
-    if pp < 1 or (pp & (pp - 1)) != 0:
-        raise ValueError(f"pp must be a power of two, got {pp}")
+    """Any pp >= 1 that divides the layer count works (the ring ppermute
+    schedule has no power-of-two requirement — 80 layers over 5 stages is
+    legal, unlike the reference's 2^n node rule)."""
+    if pp < 1:
+        raise ValueError(f"pp must be >= 1, got {pp}")
     if pp > 1 and h.n_layers % pp != 0:
         raise ValueError(
             f"nLayers={h.n_layers} not divisible by pp={pp} (stages hold "
@@ -78,12 +81,24 @@ def forward_pp(
     attn_window: int = 0,
     attn_park_threshold: int = 0,
     logits_mode: str = "all",
+    n_micro: int = 1,
 ):
     """Pipeline-parallel forward: same contract as models.forward.
 
     Stage-local compute runs with mesh=None (plain kernels, no nested
     shard_map); tp/sp composition inside a stage is future work — the
     engine currently accepts pp with tp=sp=dp=1.
+
+    `n_micro` > 1 splits the CHUNK (T) axis into sequence-wave
+    microbatches, GPipe-style: at tick t stage s processes chunk t - s,
+    so all stages work concurrently on successive chunks once the
+    pipeline fills — utilization n_micro / (pp + n_micro - 1) instead of
+    1/pp. Causality holds because chunk c reaches stage s only after
+    chunks < c committed their KV rows at that stage (earlier ticks).
+    Prefill is compute-bound, so this is where the pp bubble actually
+    costs time; decode (T=1, weight-bandwidth-bound) keeps n_micro=1 —
+    splitting lanes into groups would re-read the stage's weights per
+    group and erase the batching win. Requires T % n_micro == 0.
     """
     from jax import shard_map
 
@@ -95,7 +110,10 @@ def forward_pp(
     )
 
     pp = mesh.shape["pp"]
-    t = tokens.shape[1]
+    b, t = tokens.shape
+    if t % n_micro != 0:
+        raise ValueError(f"T={t} not divisible by n_micro={n_micro}")
+    tc = t // n_micro
     attn_pos = attn_positions(pos, attn_park_threshold, cache["k"].shape[3])
 
     layers = params["layers"]
@@ -106,31 +124,78 @@ def forward_pp(
 
     stage_spec = P("pp")  # prefix spec: leading (layer) axis of every leaf
     repl = P()
+    ring = [(i, (i + 1) % pp) for i in range(pp)]
+
+    # logits_mode="last" (every prefill/decode step) only consumes the
+    # final chunk's rows: keep a [B, tc, D] exit register instead of the
+    # [B, T, D] buffer, shrinking both the HLO live range and the final
+    # cross-stage psum payload by a factor of n_micro
+    keep_all = logits_mode == "all"
 
     def body(layers, k_c, v_c, globals_, tokens, pos, attn_pos):
         stage = lax.axis_index("pp")
-        cos, sin = rope_slices(globals_, pos, t)
-        x = globals_["embed"][tokens]  # [B, T, D]
-        for tick in range(pp):
+        d = globals_["embed"].shape[-1]
+        x0 = jnp.zeros((b, tc, d), globals_["embed"].dtype)  # stage register
+        done0 = jnp.zeros((b, t if keep_all else tc, d), x0.dtype)
+
+        def tick_body(tick, carry):
+            # stage s processes chunk c = tick - s this tick (when valid);
+            # stage 0 injects chunk `tick`'s embedding first. One traced
+            # instance of the stage program serves every tick (the
+            # schedule runs under fori_loop — unrolling would inline
+            # pp + n_micro - 1 copies of the layer scan per compile).
+            x, x_done, k_c, v_c = carry
+            inj = lax.dynamic_slice_in_dim(
+                tokens, jnp.clip(tick * tc, 0, t - tc), tc, axis=1
+            )
+            x = jnp.where(
+                jnp.logical_and(stage == 0, tick < n_micro),
+                globals_["embed"][inj],
+                x,
+            )
+            c = tick - stage
+            valid = jnp.logical_and(c >= 0, c < n_micro)
+            c_safe = jnp.clip(c, 0, n_micro - 1)
+            pos_c = pos + c_safe * tc
+            attn_pos_c = attn_pos + c_safe * tc
+            cos, sin = rope_slices(globals_, pos_c, tc)
             x_out, k_new, v_new = run_layers(
-                x, layers, k_c, v_c, h, pos, attn_pos, cos, sin,
+                x, layers, k_c, v_c, h, pos_c, attn_pos_c, cos, sin,
                 mesh=None, attn_window=attn_window,
             )
-            active = stage == tick
-            # commit this stage's cache range only on its active tick;
-            # inactive ticks computed on pass-through data
-            k_c = jnp.where(active, k_new, k_c)
-            v_c = jnp.where(active, v_new, v_c)
-            x = jnp.where(active, x_out, x)
-            # hand the activation to the next stage; after the last tick
-            # this rotates the final stage's result onto stage 0
-            x = lax.ppermute(
-                x, "pp", [(i, (i + 1) % pp) for i in range(pp)]
-            )
-        # broadcast stage 0's (final) activation to all stages, then every
-        # stage computes the replicated logits head
-        x = lax.psum(jnp.where(stage == 0, x, jnp.zeros_like(x)), "pp")
-        logits = logits_head(x, globals_, h, None, logits_mode)
+            # commit this stage's cache range only for a valid chunk;
+            # invalid ticks computed on pass-through/fill data
+            k_c = jnp.where(valid, k_new, k_c)
+            v_c = jnp.where(valid, v_new, v_c)
+            x = jnp.where(valid, x_out, x)
+            # a chunk finishing the LAST stage exits into the output
+            # register (every stage computes the update; only the last
+            # stage's is kept)
+            exited = jnp.logical_and(valid, stage == pp - 1)
+            if keep_all:
+                x_done = jnp.where(
+                    exited,
+                    lax.dynamic_update_slice_in_dim(
+                        x_done, x, c_safe * tc, axis=1
+                    ),
+                    x_done,
+                )
+            else:  # only the final chunk's rows feed logits_mode="last"
+                x_done = jnp.where(
+                    jnp.logical_and(exited, c == n_micro - 1), x, x_done
+                )
+            # hand the register to the next stage
+            x = lax.ppermute(x, "pp", ring)
+            return x, x_done, k_c, v_c
+
+        _, x_done, k_c, v_c = lax.fori_loop(
+            0, pp + n_micro - 1, tick_body, (x0, done0, k_c, v_c)
+        )
+        # collect the output from the last stage onto every stage
+        x_done = lax.psum(
+            jnp.where(stage == pp - 1, x_done, jnp.zeros_like(x_done)), "pp"
+        )
+        logits = logits_head(x_done, globals_, h, None, logits_mode)
         return logits, k_c, v_c
 
     logits, k_new, v_new = shard_map(

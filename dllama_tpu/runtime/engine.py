@@ -264,17 +264,19 @@ class InferenceEngine:
             from ..parallel.pipeline import forward_pp
 
             def fwd(params, tokens, pos, cache, *, attn_window=0,
-                    logits_mode="all", attn_park_threshold=0):
+                    logits_mode="all", attn_park_threshold=0, n_micro=1):
                 return forward_pp(
                     params, h, tokens, pos, cache, mesh,
                     attn_window=attn_window, logits_mode=logits_mode,
                     attn_park_threshold=attn_park_threshold,
+                    n_micro=n_micro,
                 )
 
         else:
 
             def fwd(params, tokens, pos, cache, *, attn_window=0,
-                    logits_mode="all", attn_park_threshold=0):
+                    logits_mode="all", attn_park_threshold=0, n_micro=1):
+                del n_micro  # sequence-wave microbatching is pp-only
                 return forward(
                     params, h, tokens, pos, cache, mesh=mesh,
                     attn_window=attn_window, logits_mode=logits_mode,
@@ -283,6 +285,18 @@ class InferenceEngine:
                 )
 
         self._fwd = fwd
+
+    def _pp_micro(self, t: int) -> int:
+        """Sequence-wave microbatch count for a T-wide pp prefill chunk:
+        prefer ~4 chunks in flight per stage (utilization
+        n_micro/(pp+n_micro-1)) while keeping >= 8 rows per wave (flash-
+        kernel-friendly; tiny waves would be launch-overhead-bound)."""
+        if self.pp == 1 or t < 2 * self.pp:
+            return 1
+        for k in (4 * self.pp, 2 * self.pp, self.pp):
+            if t % k == 0 and t // k >= 8:
+                return k
+        return 1
 
     # -- cache ---------------------------------------------------------------
 
@@ -354,6 +368,7 @@ class InferenceEngine:
                 logits, cache = fwd(
                     params, tokens, pos, cache,
                     attn_window=window, logits_mode="last",
+                    n_micro=self._pp_micro(t),
                 )
             last = logits[:, -1, :]
             if greedy:
@@ -479,6 +494,7 @@ class InferenceEngine:
             with ctx:
                 logits, cache = fwd(
                     params, tokens, pos, cache, attn_window=window,
+                    n_micro=self._pp_micro(t),
                 )
             lg = logits.astype(jnp.float32)  # [B, T, V]
             lse = jax.nn.logsumexp(lg, axis=-1)  # [B, T]
@@ -583,7 +599,7 @@ class InferenceEngine:
                 _, cache = fwd(
                     params, tokens, pos_vec, cache,
                     attn_window=window, attn_park_threshold=park,
-                    logits_mode="last",
+                    logits_mode="last", n_micro=self._pp_micro(t),
                 )
             return cache
 
