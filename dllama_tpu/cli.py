@@ -202,7 +202,7 @@ def run_inference(args) -> None:
     act_bytes = 1.125 if engine._sync_quant else 4.0
     per_tok_bytes = _ici(
         engine.header, engine.tp, activation_bytes=act_bytes,
-        include_logits=False,
+        include_logits=False, pp=engine.pp,
     )
     logits_bytes = (
         _ici(engine.header, engine.tp, activation_bytes=act_bytes)

@@ -96,11 +96,11 @@ def memory_report(params, cache, n_devices: int = 1) -> MemoryReport:
 
 def ici_traffic_per_token(
     h: LlmHeader, tp: int, activation_bytes: float = 2.0,
-    include_logits: bool = True,
+    include_logits: bool = True, pp: int = 1,
 ) -> int:
-    """Analytic per-decoded-token ICI bytes per chip for the TP layout.
+    """Analytic per-decoded-token ICI bytes per chip for the TP/PP layout.
 
-    Two all-reduces of a [dim] activation per layer (after attention's
+    TP: two all-reduces of a [dim] activation per layer (after attention's
     col-split wo and the FFN's col-split w2 — where the reference ran
     SYNC_NODE_SLICES + MERGE_ADD, llm.cpp:403,554) plus the logits
     all-gather (vocab/tp per chip receives the rest). Ring all-reduce moves
@@ -108,13 +108,21 @@ def ici_traffic_per_token(
     f32 psum payload, 1.125 for Q80-compressed sync
     (buffer_float_type="q80", parallel/collectives.psum_q80 — the
     reference's README.md:89 ~26% figure), 2 for bf16 GSPMD all-reduces.
+
+    PP: one [dim] activation ppermute per pipeline tick (pp ticks per
+    decode token, parallel/pipeline.forward_pp) plus the exit-register
+    all-reduce — tiny next to the tp terms, listed for honesty.
     """
-    if tp <= 1:
-        return 0
-    ring = 2 * (tp - 1) / tp
-    per_layer = 2 * h.dim * activation_bytes * ring
-    logits = h.vocab_size * 4 * (tp - 1) / tp if include_logits else 0.0
-    return int(h.n_layers * per_layer + logits)
+    total = 0.0
+    if tp > 1:
+        ring = 2 * (tp - 1) / tp
+        total += h.n_layers * 2 * h.dim * activation_bytes * ring
+        if include_logits:
+            total += h.vocab_size * 4 * (tp - 1) / tp
+    if pp > 1:
+        total += pp * h.dim * activation_bytes  # tick hand-offs
+        total += 2 * (pp - 1) / pp * h.dim * activation_bytes  # exit psum
+    return int(total)
 
 
 @contextlib.contextmanager

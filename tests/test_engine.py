@@ -425,3 +425,15 @@ def test_attn_window_equivalence(tmp_path):
     out_cross_full, _, _ = e2.generate(prompt, max_steps=530)
     assert out_cross == out_cross_full
     assert len(out_cross) == 530 - (len(prompt) - 1)
+
+
+def test_ici_traffic_accounts_pp():
+    from dllama_tpu.models.synthetic import make_header
+    from dllama_tpu.utils.telemetry import ici_traffic_per_token
+
+    h = make_header("tiny")
+    assert ici_traffic_per_token(h, 1, pp=1) == 0
+    t_pp = ici_traffic_per_token(h, 1, pp=2)
+    assert t_pp > 0  # tick hand-offs + exit psum
+    # pp traffic is per-token tiny next to tp's per-layer all-reduces
+    assert t_pp < ici_traffic_per_token(h, 2, include_logits=False)
