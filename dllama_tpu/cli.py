@@ -55,9 +55,9 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline stages: each holds nLayers/pp layers + "
                         "that range's KV cache — fits models past the "
-                        "tp <= nKvHeads ceiling; composes with "
-                        "--batch-size lanes (tp/sp composition is "
-                        "future work)")
+                        "tp <= nKvHeads ceiling; composes with --tp "
+                        "(stages of tp groups; chips = pp x tp) and "
+                        "--batch-size lanes")
     p.add_argument("--workers", nargs="*", default=None, help="alias for --tp: pass a chip count (host:port lists are a LAN-cluster concept)")
     p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--kv-dtype", default=None, choices=[None, "bf16", "f32"])
@@ -122,7 +122,7 @@ def load_engine(args):
     sp = getattr(args, "sp", 1) or 1
     pp = getattr(args, "pp", 1) or 1
     if pp > 1 and tp == 0:
-        tp = 1  # pp is exclusive with tp for now; don't auto-expand tp
+        tp = 1  # with --pp, scale tp explicitly (chips needed = pp x tp)
     if tp == 0:
         from .parallel.mesh import auto_tp
 

@@ -54,6 +54,32 @@ def _mm(x: jnp.ndarray, w, role: str, mesh, sync_quant: bool = False) -> jnp.nda
     return jnp.einsum("bti,io->bto", x, w)
 
 
+def _mm_manual(
+    x: jnp.ndarray, w, role: str, axis: str | None, sync_quant: bool = False
+) -> jnp.ndarray:
+    """Matmul for MANUAL-collective contexts (inside an enclosing
+    shard_map, e.g. a pipeline stage's tp group): `w` is already this
+    shard's local slice, the kernel runs locally, and the col-split
+    partial sum all-reduces over `axis` exactly where qmatmul_tp's own
+    shard_map would have psummed — in f32, downcasting AFTER the
+    reduction like the flat path (rounding each partial before summing
+    would compound per layer). `sync_quant` Q80-compresses the psum
+    payload (the reference's --buffer-float-type q80), same as the flat
+    path. `axis=None` = single-shard stage."""
+    from ..ops.quant_matmul import qmatmul
+
+    def reduce(out):
+        if role == "col" and axis is not None:
+            from ..parallel.collectives import psum_maybe_quantized
+
+            return psum_maybe_quantized(out, axis, sync_quant)
+        return out
+
+    if isinstance(w, QuantWeight):
+        return reduce(qmatmul(x, w)).astype(x.dtype)
+    return reduce(jnp.einsum("bti,io->bto", x, w))
+
+
 def _split_fused(out: jnp.ndarray, tp: int, dims: tuple[int, ...]):
     """Un-interleave a fused row-split matmul output [B, T, sum(dims)]
     whose columns are laid out shard-major (loader._interleave_concat):
@@ -604,14 +630,32 @@ def rope_slices(params: Params, pos: jnp.ndarray, t: int):
     return cos, sin
 
 
-def logits_head(x, params: Params, h: LlmHeader, mesh, logits_mode: str):
-    """Final norm + vocab matmul (reference: src/llm.cpp:560-599)."""
+def logits_head(
+    x, params: Params, h: LlmHeader, mesh, logits_mode: str,
+    tp_axis: str | None = None,
+):
+    """Final norm + vocab matmul (reference: src/llm.cpp:560-599).
+
+    `tp_axis`: manual-collective mode (pipeline stages): `wcls` is this
+    shard's vocab slice; the local logits all-gather over the axis — the
+    reference's logits gather-to-root (llm.cpp:599), moved on-chip."""
     if logits_mode not in ("all", "last"):
         raise ValueError(f"unknown logits_mode: {logits_mode!r}")
     if logits_mode == "last":
         x = x[:, -1:, :]
     y = rms_norm(x, params["final_norm"], h.norm_epsilon)
     wcls = params["wcls"]
+    if tp_axis is not None:
+        from ..ops.quant_matmul import qmatmul
+
+        if isinstance(wcls, QuantWeight):
+            local = qmatmul(y, wcls)
+        else:
+            local = jnp.einsum(
+                "btd,dv->btv", y.astype(jnp.float32),
+                wcls.astype(jnp.float32),
+            )
+        return lax.all_gather(local, tp_axis, axis=-1, tiled=True)
     if isinstance(wcls, QuantWeight):
         return qmatmul_tp(y, wcls, "row", mesh)
     return jnp.einsum(
@@ -633,6 +677,8 @@ def run_layers(
     attn_window: int = 0,
     sync_quant: bool = False,
     moe_gather_max_tokens: int = 0,
+    tp_axis: str | None = None,
+    tp_n: int = 1,
 ):
     """`lax.scan` the decoder layers over x; returns (x, k_new, v_new).
 
@@ -640,14 +686,30 @@ def run_layers(
     (parallel/pipeline.py) can run a STAGE'S LOCAL layer slice with
     identical math — there `layers`/caches carry L/pp layers and
     mesh=None (each stage computes locally; activations ride ppermute).
+
+    `tp_axis`/`tp_n`: MANUAL tensor parallelism for callers already
+    inside a shard_map (a pipeline stage's tp group): weights arrive as
+    this shard's local slices (out dims / tp_n for row splits, kv-heads /
+    tp_n on the cache), kernels run locally, and col-split partial sums
+    psum over `tp_axis` — the same collective placement qmatmul_tp's own
+    shard_map produces on a flat mesh. Requires mesh=None.
     """
     b, t = x.shape[0], x.shape[1]
     interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
     act = silu if h.hidden_act == HiddenAct.SILU else gelu
     is_qwen3 = h.arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE)
     per_lane = jnp.ndim(pos) == 1
+    if tp_axis is not None and mesh is not None:
+        raise ValueError("manual tp (tp_axis) requires mesh=None")
+    # per-shard head/out dims (tp_n=1 on the flat/GSPMD path)
+    hq, hkv = h.n_heads // tp_n, h.n_kv_heads // tp_n
     # mesh tp size: per-shard shape checks (MoE kernel gate)
     _tp_n = mesh.shape.get("tp", 1) if mesh is not None else 1
+
+    def mm(yy, w, role, sync=False):
+        if tp_axis is not None:
+            return _mm_manual(yy, w, role, tp_axis, sync and sync_quant)
+        return _mm(yy, w, role, mesh, sync and sync_quant)
 
     def _cache_append(cache_l, val):
         """Write the chunk at each lane's position (reference: OP_SHIFT,
@@ -672,17 +734,27 @@ def run_layers(
             # chip; docs/silicon_r03.md). The un-interleave factor is the
             # weight's own static metadata, not the mesh's tp — a fused-
             # load/mesh mismatch stays correct (just non-optimally laid
-            # out) instead of silently permuting columns.
+            # out) instead of silently permuting columns. Under manual tp
+            # the shard's local slice is one interleave chunk (the shard-
+            # major layout puts shard i's [q_i|k_i|v_i] in chunk i), so
+            # the local split factor is fuse / tp_n.
             fw = lp["wqkv"]
-            qkv = _mm(y, fw.weight, "row", mesh)
-            q, k, v = _split_fused(qkv, fw.fuse, fw.dims)
-            q = q.reshape(b, t, h.n_heads, h.head_dim)
-            k = k.reshape(b, t, h.n_kv_heads, h.head_dim)
-            v = v.reshape(b, t, h.n_kv_heads, h.head_dim)
+            if fw.fuse % tp_n != 0:
+                raise ValueError(
+                    f"fused weight interleave {fw.fuse} incompatible with "
+                    f"manual tp_n={tp_n}"
+                )
+            qkv = mm(y, fw.weight, "row")
+            q, k, v = _split_fused(
+                qkv, fw.fuse // tp_n, tuple(d // tp_n for d in fw.dims)
+            )
+            q = q.reshape(b, t, hq, h.head_dim)
+            k = k.reshape(b, t, hkv, h.head_dim)
+            v = v.reshape(b, t, hkv, h.head_dim)
         else:
-            q = _mm(y, lp["wq"], "row", mesh).reshape(b, t, h.n_heads, h.head_dim)
-            k = _mm(y, lp["wk"], "row", mesh).reshape(b, t, h.n_kv_heads, h.head_dim)
-            v = _mm(y, lp["wv"], "row", mesh).reshape(b, t, h.n_kv_heads, h.head_dim)
+            q = mm(y, lp["wq"], "row").reshape(b, t, hq, h.head_dim)
+            k = mm(y, lp["wk"], "row").reshape(b, t, hkv, h.head_dim)
+            v = mm(y, lp["wv"], "row").reshape(b, t, hkv, h.head_dim)
         if is_qwen3:
             q = qk_rms_norm(q, lp["q_norm"], h.norm_epsilon)
             k = qk_rms_norm(k, lp["k_norm"], h.norm_epsilon)
@@ -698,7 +770,7 @@ def run_layers(
         else:
             k_view, v_view = k_cache_l, v_cache_l
         z = _attention_tp(q, k_view, v_view, attn_pos, h.head_dim, mesh)
-        x = x + _mm(z, lp["wo"], "col", mesh, sync_quant).astype(x.dtype)
+        x = x + mm(z, lp["wo"], "col", sync=True).astype(x.dtype)
 
         # -- FFN block (reference: src/llm.cpp:405-557) --
         y = rms_norm(x, lp["ffn_norm"], h.norm_epsilon)
@@ -755,17 +827,24 @@ def run_layers(
                     h.n_active_experts,
                     act,
                 )
+            if tp_axis is not None:
+                # manual tp: experts arrived F-sliced (same layout the
+                # mesh path shards); the local partial outputs all-reduce
+                # here instead of inside the helpers' shard_map
+                f = lax.psum(f, tp_axis)
         elif "w13" in lp:
             # fused w1|w3: the SwiGLU pair shares its input and activation
             fw13 = lp["w13"]
-            dl13 = _mm(y, fw13.weight, "row", mesh)
-            d1, l3 = _split_fused(dl13, fw13.fuse, fw13.dims)
+            dl13 = mm(y, fw13.weight, "row")
+            d1, l3 = _split_fused(
+                dl13, fw13.fuse // tp_n, tuple(d // tp_n for d in fw13.dims)
+            )
             d = act(d1)
-            f = _mm(d * l3.astype(d.dtype), lp["w2"], "col", mesh, sync_quant)
+            f = mm(d * l3.astype(d.dtype), lp["w2"], "col", sync=True)
         else:
-            d = act(_mm(y, lp["w1"], "row", mesh))
-            l = _mm(y, lp["w3"], "row", mesh)
-            f = _mm(d * l.astype(d.dtype), lp["w2"], "col", mesh, sync_quant)
+            d = act(mm(y, lp["w1"], "row"))
+            l = mm(y, lp["w3"], "row")
+            f = mm(d * l.astype(d.dtype), lp["w2"], "col", sync=True)
         x = x + f.astype(x.dtype)
         return x, (k_cache_l, v_cache_l)
 

@@ -86,8 +86,18 @@ def forward_pp(
     """Pipeline-parallel forward: same contract as models.forward.
 
     Stage-local compute runs with mesh=None (plain kernels, no nested
-    shard_map); tp/sp composition inside a stage is future work — the
-    engine currently accepts pp with tp=sp=dp=1.
+    shard_map). When the mesh also carries a `tp` axis, each stage is a
+    TENSOR-PARALLEL GROUP: weights arrive row/col-sliced per the same
+    PartitionSpecs the flat mesh uses (pp_param_specs over
+    param_spec_tree), kernels run on the local slices, and the col-split
+    partial sums / MoE outputs psum over "tp" INSIDE the stage
+    (run_layers tp_axis) — pp x tp is how a 70B+ checkpoint outgrows the
+    tp <= nKvHeads ceiling: stages of tp groups. sp/dp composition is
+    future work. The manual partial-sum order differs from the flat
+    mesh's single reduction, so low-precision (bf16) greedy streams can
+    flip argmax near-ties on near-uniform logits — the same neutral
+    divergence class any tensor-parallel partial summing has (f32 runs
+    match the flat mesh exactly; tests pin that).
 
     `n_micro` > 1 splits the CHUNK (T) axis into sequence-wave
     microbatches, GPipe-style: at tick t stage s processes chunk t - s,
@@ -110,6 +120,7 @@ def forward_pp(
     )
 
     pp = mesh.shape["pp"]
+    tp = mesh.shape.get("tp", 1)
     b, t = tokens.shape
     if t % n_micro != 0:
         raise ValueError(f"T={t} not divisible by n_micro={n_micro}")
@@ -122,7 +133,18 @@ def forward_pp(
         for k in ("embed", "wcls", "final_norm", "rope_cos", "rope_sin")
     }
 
-    stage_spec = P("pp")  # prefix spec: leading (layer) axis of every leaf
+    if tp > 1:
+        # per-leaf pp x tp specs: leading layer axis over stages, row/col
+        # matmul splits over the stage's tp group (the flat mesh's rules,
+        # parallel/sharding.param_spec_tree, pp-prefixed)
+        from ..parallel.sharding import param_spec_tree
+
+        layer_specs = pp_param_specs(param_spec_tree(h))["layers"]
+        layers_spec = {k: layer_specs[k] for k in layers}
+        cache_spec = P("pp", "dp", "tp", None, None)
+    else:
+        layers_spec = P("pp")  # prefix: leading (layer) axis of every leaf
+        cache_spec = P("pp")
     repl = P()
     ring = [(i, (i + 1) % pp) for i in range(pp)]
 
@@ -162,6 +184,7 @@ def forward_pp(
             x_out, k_new, v_new = run_layers(
                 x, layers, k_c, v_c, h, pos_c, attn_pos_c, cos, sin,
                 mesh=None, attn_window=attn_window,
+                tp_axis="tp" if tp > 1 else None, tp_n=tp,
             )
             # commit this stage's cache range only for a valid chunk;
             # invalid ticks computed on pass-through/fill data
@@ -201,8 +224,8 @@ def forward_pp(
     logits, k_new, v_new = shard_map(
         body,
         mesh=mesh,
-        in_specs=(stage_spec, stage_spec, stage_spec, repl, repl, repl, repl),
-        out_specs=(repl, stage_spec, stage_spec),
+        in_specs=(layers_spec, cache_spec, cache_spec, repl, repl, repl, repl),
+        out_specs=(repl, cache_spec, cache_spec),
         check_vma=False,
     )(layers, cache["k"], cache["v"], globals_, tokens, pos, attn_pos)
     return logits, {"k": k_new, "v": v_new}

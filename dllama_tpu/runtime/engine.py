@@ -159,10 +159,10 @@ class InferenceEngine:
         from ..parallel.pipeline import validate_pp
 
         validate_pp(self.header, pp)
-        if pp > 1 and (tp > 1 or dp > 1 or sp > 1):
+        if pp > 1 and (dp > 1 or sp > 1):
             raise ValueError(
-                "pp currently composes with tp=dp=sp=1 (stage-local "
-                "tensor/sequence splits are future work)"
+                "pp composes with tp (stages of tp groups) but not yet "
+                "with dp/sp"
             )
         self.mesh = make_mesh(tp=tp, dp=dp, sp=sp, pp=pp)
         self.tp, self.dp, self.sp, self.pp = tp, dp, sp, pp
