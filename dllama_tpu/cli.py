@@ -180,6 +180,8 @@ def load_engine(args):
 
 def run_inference(args) -> None:
     """(reference: dllama.cpp:13-116)"""
+    import jax.numpy as jnp
+
     from .utils.telemetry import profile
 
     engine, tok = load_engine(args)
@@ -198,14 +200,19 @@ def run_inference(args) -> None:
     from .utils.telemetry import ici_traffic_per_token as _ici
 
     # q80-compressed sync moves 1.125 B/elem (int8 + f32/32 scales);
-    # exact f32 psum moves 4
+    # exact f32 psum moves 4. The pp hand-offs always ride uncompressed
+    # in the model activation dtype.
     act_bytes = 1.125 if engine._sync_quant else 4.0
+    pp_bytes = float(jnp.dtype(engine.dtype).itemsize)
     per_tok_bytes = _ici(
         engine.header, engine.tp, activation_bytes=act_bytes,
-        include_logits=False, pp=engine.pp,
+        include_logits=False, pp=engine.pp, pp_activation_bytes=pp_bytes,
     )
     logits_bytes = (
-        _ici(engine.header, engine.tp, activation_bytes=act_bytes)
+        _ici(
+            engine.header, engine.tp, activation_bytes=act_bytes,
+            pp=engine.pp, pp_activation_bytes=pp_bytes,
+        )
         - per_tok_bytes
     )
 

@@ -82,6 +82,7 @@ def forward_pp(
     attn_park_threshold: int = 0,
     logits_mode: str = "all",
     n_micro: int = 1,
+    sync_quant: bool = False,
 ):
     """Pipeline-parallel forward: same contract as models.forward.
 
@@ -139,12 +140,21 @@ def forward_pp(
         # parallel/sharding.param_spec_tree, pp-prefixed)
         from ..parallel.sharding import param_spec_tree
 
-        layer_specs = pp_param_specs(param_spec_tree(h))["layers"]
+        all_specs = param_spec_tree(h)
+        layer_specs = pp_param_specs(all_specs)["layers"]
         layers_spec = {k: layer_specs[k] for k in layers}
         cache_spec = P("pp", "dp", "tp", None, None)
+        # wcls keeps its vocab-axis tp shard (pp-replicated): each stage's
+        # tp group computes its vocab slice and all-gathers inside the
+        # body (logits_head tp_axis) — passing it replicated would
+        # re-all-gather the full vocab matrix onto every chip per step
+        globals_spec = {
+            k: (all_specs["wcls"] if k == "wcls" else P()) for k in globals_
+        }
     else:
         layers_spec = P("pp")  # prefix: leading (layer) axis of every leaf
         cache_spec = P("pp")
+        globals_spec = P()
     repl = P()
     ring = [(i, (i + 1) % pp) for i in range(pp)]
 
@@ -184,6 +194,7 @@ def forward_pp(
             x_out, k_new, v_new = run_layers(
                 x, layers, k_c, v_c, h, pos_c, attn_pos_c, cos, sin,
                 mesh=None, attn_window=attn_window,
+                sync_quant=sync_quant,
                 tp_axis="tp" if tp > 1 else None, tp_n=tp,
             )
             # commit this stage's cache range only for a valid chunk;
@@ -218,13 +229,19 @@ def forward_pp(
         x_done = lax.psum(
             jnp.where(stage == pp - 1, x_done, jnp.zeros_like(x_done)), "pp"
         )
-        logits = logits_head(x_done, globals_, h, None, logits_mode)
+        logits = logits_head(
+            x_done, globals_, h, None, logits_mode,
+            tp_axis="tp" if tp > 1 else None,
+        )
         return logits, k_c, v_c
 
     logits, k_new, v_new = shard_map(
         body,
         mesh=mesh,
-        in_specs=(layers_spec, cache_spec, cache_spec, repl, repl, repl, repl),
+        in_specs=(
+            layers_spec, cache_spec, cache_spec, globals_spec, repl, repl,
+            repl,
+        ),
         out_specs=(repl, cache_spec, cache_spec),
         check_vma=False,
     )(layers, cache["k"], cache["v"], globals_, tokens, pos, attn_pos)

@@ -269,7 +269,7 @@ class InferenceEngine:
                     params, h, tokens, pos, cache, mesh,
                     attn_window=attn_window, logits_mode=logits_mode,
                     attn_park_threshold=attn_park_threshold,
-                    n_micro=n_micro,
+                    n_micro=n_micro, sync_quant=sync_quant,
                 )
 
         else:

@@ -97,6 +97,7 @@ def memory_report(params, cache, n_devices: int = 1) -> MemoryReport:
 def ici_traffic_per_token(
     h: LlmHeader, tp: int, activation_bytes: float = 2.0,
     include_logits: bool = True, pp: int = 1,
+    pp_activation_bytes: float | None = None,
 ) -> int:
     """Analytic per-decoded-token ICI bytes per chip for the TP/PP layout.
 
@@ -111,7 +112,11 @@ def ici_traffic_per_token(
 
     PP: one [dim] activation ppermute per pipeline tick (pp ticks per
     decode token, parallel/pipeline.forward_pp) plus the exit-register
-    all-reduce — tiny next to the tp terms, listed for honesty.
+    all-reduce — tiny next to the tp terms, listed for honesty. These
+    hand-offs carry UNCOMPRESSED activations (the stage register's model
+    dtype), so they get their own `pp_activation_bytes` (defaults to
+    `activation_bytes`) — Q80 sync compression applies only to the tp
+    partial-sum psums, never to the pipeline hops.
     """
     total = 0.0
     if tp > 1:
@@ -120,8 +125,9 @@ def ici_traffic_per_token(
         if include_logits:
             total += h.vocab_size * 4 * (tp - 1) / tp
     if pp > 1:
-        total += pp * h.dim * activation_bytes  # tick hand-offs
-        total += 2 * (pp - 1) / pp * h.dim * activation_bytes  # exit psum
+        ppb = activation_bytes if pp_activation_bytes is None else pp_activation_bytes
+        total += pp * h.dim * ppb  # tick hand-offs
+        total += 2 * (pp - 1) / pp * h.dim * ppb  # exit psum
     return int(total)
 
 

@@ -251,6 +251,58 @@ def test_forward_pp_with_tp(tmp_path):
             )
 
 
+def test_forward_pp_tp_wcls_stays_sharded(tmp_path):
+    """Under pp x tp the vocab head must keep wcls tp-sharded and compute
+    per-shard logits slices (logits_head tp_axis): the ONLY all-gather in
+    the compiled program is the [B, T, V] logits gather over the tp
+    groups. A replicated wcls in_spec would add a weight-sized [D, V]
+    all-gather per step — GB-scale on a real 70B layout."""
+    from dllama_tpu.parallel.sharding import shard_params_put
+
+    path = str(tmp_path / "mtp.m")
+    make_tiny_model(path, weight_type=FloatType.Q40, seed=11, cfg=CFG4_TP)
+    r = ModelReader(path)
+    h = r.header
+    mesh = make_mesh(pp=2, tp=2)
+    params = load_params(
+        r, weight_format="dense", put=shard_params_put(mesh, h)
+    )
+    tokens = jnp.asarray([TOKENS], jnp.int32)
+    cache = init_kv_cache(h, 1)
+    f = jax.jit(
+        lambda p, t, c: forward_pp(p, h, t, jnp.int32(0), c, mesh)
+    )
+    txt = f.lower(params, tokens, cache).compile().as_text()
+    gathers = [ln for ln in txt.splitlines() if "all-gather(" in ln]
+    assert len(gathers) == 1, gathers
+    b, t = tokens.shape
+    assert f"f32[{b},{t},{h.vocab_size}]" in gathers[0], gathers[0]
+
+
+def test_forward_pp_tp_sync_quant(tmp_path):
+    """buffer_float_type=q80 must reach the pp x tp stage-local partial
+    sums (not be silently dropped): logits stay within quantization
+    tolerance of the exact run AND differ from it (the compressed
+    collective actually ran)."""
+    h, params = _params_tp(tmp_path)
+    mesh = make_mesh(pp=2, tp=2)
+    tokens = jnp.asarray([TOKENS], jnp.int32)
+    lg_exact, _ = forward_pp(
+        params, h, tokens, jnp.int32(0), init_kv_cache(h, 1), mesh,
+        sync_quant=False,
+    )
+    lg_q80, _ = forward_pp(
+        params, h, tokens, jnp.int32(0), init_kv_cache(h, 1), mesh,
+        sync_quant=True,
+    )
+    exact = np.asarray(lg_exact)
+    q80 = np.asarray(lg_q80)
+    scale = np.abs(exact).max()
+    err = np.abs(q80 - exact).max()
+    assert err / scale < 2e-2, (err, scale)
+    assert err > 0.0  # compression actually happened
+
+
 def test_engine_pp_x_tp_matches_single_device(tmp_path):
     """Engine-level pp=2 x tp=2 (4 virtual chips): generated tokens match
     the single-device stream for fused q40."""
