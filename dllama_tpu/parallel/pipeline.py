@@ -148,9 +148,7 @@ def forward_pp(
         # tp group computes its vocab slice and all-gathers inside the
         # body (logits_head tp_axis) — passing it replicated would
         # re-all-gather the full vocab matrix onto every chip per step
-        globals_spec = {
-            k: (all_specs["wcls"] if k == "wcls" else P()) for k in globals_
-        }
+        globals_spec = {k: all_specs[k] for k in globals_}
     else:
         layers_spec = P("pp")  # prefix: leading (layer) axis of every leaf
         cache_spec = P("pp")
