@@ -39,12 +39,15 @@ NORTH_STAR_TOK_S_PER_CHIP = 50.0  # BASELINE.json: 70B Q40 on v5e-8
 BASELINE_DEF = "50 tok/s/chip north star (BASELINE.json 70B-on-v5e-8)"
 
 
-def weight_bytes_per_token(h, weight_format: str) -> int:
+def weight_bytes_per_token(h, weight_format: str, i8_group: int = 512) -> int:
     """HBM bytes of weights a single decode step must read: every matmul
     weight once (MoE: attention weights + the active experts' share).
     Q40 device layout = int8 values + f32 scale per 32 block = 1.125
-    B/weight; dense bf16 = 2 B/weight."""
-    bpw = 1.125 if weight_format == "q40" else 2.0
+    B/weight; grouped int8 = 1 + 4/G; dense bf16 = 2 B/weight."""
+    bpw = {
+        "q40": 1.125,
+        "q40i8": 1.0 + 4.0 / i8_group,
+    }.get(weight_format, 2.0)
     att = h.dim * h.q_dim + 2 * h.dim * h.kv_dim + h.q_dim * h.dim
     ffn = 3 * h.dim * h.ff_dim
     if h.n_experts:
@@ -251,7 +254,7 @@ def main() -> None:
     params = random_params(
         h, dtype=jnp.bfloat16, mesh=mesh, weight_format=weight_format,
         # fused qkv/w13 launches, like the engine's q40 default
-        fuse=tp if weight_format == "q40" else 0,
+        fuse=tp if weight_format in ("q40", "q40i8") else 0,
     )
     cache = init_kv_cache(h, batch_size=1, dtype=jnp.bfloat16)
     cspecs = cache_specs(h)
@@ -295,7 +298,14 @@ def main() -> None:
     dt = time.perf_counter() - t0
     tok_s = steps / dt
     per_chip = tok_s / tp
-    w_bytes = weight_bytes_per_token(h, weight_format)
+    if weight_format == "q40i8":
+        from dllama_tpu.ops.int8_matmul import pick_group
+
+        w_bytes = weight_bytes_per_token(
+            h, weight_format, i8_group=pick_group(h, tp)
+        )
+    else:
+        w_bytes = weight_bytes_per_token(h, weight_format)
     weight_gbs = w_bytes * tok_s / tp / 1e9  # per-chip weight-read bandwidth
     log(f"{steps} decode steps in {dt:.2f}s -> {tok_s:.2f} tok/s "
         f"({per_chip:.2f}/chip, ~{weight_gbs:.0f} GB/s weight reads/chip)")

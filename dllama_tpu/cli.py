@@ -67,7 +67,8 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                    choices=[None, *CHAT_TEMPLATE_NAMES])
     p.add_argument("--gpu-index", type=int, default=None)
     p.add_argument("--gpu-segments", default=None)
-    p.add_argument("--weight-format", default="auto", choices=["auto", "q40", "dense"],
+    p.add_argument("--weight-format", default="auto",
+                   choices=["auto", "q40", "q40i8", "dense"],
                    help="q40 keeps weights block-quantized on device (Pallas kernel)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR")

@@ -159,7 +159,7 @@ def random_params(
     moe = h.arch == LlmArch.QWEN3_MOE
     E = h.n_experts
 
-    quant = weight_format == "q40"
+    quant = weight_format in ("q40", "q40i8")
     mm = mk_quant if quant else mk
     layers = {
         "att_norm": mk("att_norm", L, D, norm=True),
@@ -200,7 +200,7 @@ def random_params(
         layers["k_norm"] = mk("k_norm", L, HD, norm=True)
 
     cos, sin = rope_cache(h)
-    return {
+    params = {
         "embed": mk("embed", V, D),
         "wcls": mm("wcls", D, V),
         "final_norm": mk("final_norm", D, norm=True),
@@ -208,3 +208,10 @@ def random_params(
         "rope_sin": dev("rope_sin", sin),
         "layers": layers,
     }
+    if weight_format == "q40i8":
+        # same load path as the engine: build q40, requantize on device
+        from ..ops.int8_matmul import pick_group, requantize_params
+
+        tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+        params = requantize_params(params, h, pick_group(h, tp))
+    return params

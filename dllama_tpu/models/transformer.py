@@ -33,6 +33,7 @@ from jax import lax
 
 from ..formats.model_file import HiddenAct, LlmArch, LlmHeader, RopeType
 from ..ops.jnp_ops import apply_rope, gelu, qk_rms_norm, rms_norm, silu
+from ..ops.int8_matmul import Int8Weight, i8matmul_tp
 from ..ops.quant_matmul import QuantWeight, dequant, qmatmul_tp
 from ..ops.flash_attention import flash_attention, pick_flash_blocks
 from ..ops.moe_kernel import moe_active_experts, moe_active_experts_q40
@@ -49,6 +50,8 @@ def _mm(x: jnp.ndarray, w, role: str, mesh, sync_quant: bool = False) -> jnp.nda
     the Pallas kernel (shard_map'd per TP role on a mesh). `sync_quant`
     Q80-compresses the col-split partial-sum all-reduce payload
     (reference: --buffer-float-type q80)."""
+    if isinstance(w, Int8Weight):
+        return i8matmul_tp(x, w, role, mesh, sync_quant=sync_quant).astype(x.dtype)
     if isinstance(w, QuantWeight):
         return qmatmul_tp(x, w, role, mesh, sync_quant=sync_quant).astype(x.dtype)
     return jnp.einsum("bti,io->bto", x, w)
@@ -75,6 +78,10 @@ def _mm_manual(
             return psum_maybe_quantized(out, axis, sync_quant)
         return out
 
+    if isinstance(w, Int8Weight):
+        from ..ops.int8_matmul import i8matmul
+
+        return reduce(i8matmul(x, w)).astype(x.dtype)
     if isinstance(w, QuantWeight):
         return reduce(qmatmul(x, w)).astype(x.dtype)
     return reduce(jnp.einsum("bti,io->bto", x, w))
@@ -646,9 +653,12 @@ def logits_head(
     y = rms_norm(x, params["final_norm"], h.norm_epsilon)
     wcls = params["wcls"]
     if tp_axis is not None:
+        from ..ops.int8_matmul import i8matmul
         from ..ops.quant_matmul import qmatmul
 
-        if isinstance(wcls, QuantWeight):
+        if isinstance(wcls, Int8Weight):
+            local = i8matmul(y, wcls)
+        elif isinstance(wcls, QuantWeight):
             local = qmatmul(y, wcls)
         else:
             local = jnp.einsum(
@@ -656,6 +666,8 @@ def logits_head(
                 wcls.astype(jnp.float32),
             )
         return lax.all_gather(local, tp_axis, axis=-1, tiled=True)
+    if isinstance(wcls, Int8Weight):
+        return i8matmul_tp(y, wcls, "row", mesh)
     if isinstance(wcls, QuantWeight):
         return qmatmul_tp(y, wcls, "row", mesh)
     return jnp.einsum(

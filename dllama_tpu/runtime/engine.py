@@ -193,7 +193,13 @@ class InferenceEngine:
                 )
                 else "dense"
             )
+        if weight_format not in ("dense", "q40", "q40i8"):
+            raise ValueError(
+                f"weight_format must be 'auto', 'dense', 'q40' or 'q40i8', "
+                f"got {weight_format!r}"
+            )
         self.weight_format = weight_format
+        quantized = weight_format in ("q40", "q40i8")
         # Q80-compressed partial-sum all-reduces (the reference's
         # --buffer-float-type q80, src/llm.cpp:195): worthwhile on
         # DCN-connected multi-host pods where sync bytes are the
@@ -205,7 +211,7 @@ class InferenceEngine:
                 f"{buffer_float_type!r}"
             )
         self._sync_quant = buffer_float_type == "q80"
-        if weight_format == "q40" and tp > 1:
+        if quantized and tp > 1:
             # col-split quant weights shard the scale tensor's block axis
             # (in//32): every contraction dim must divide by 32*tp
             for dim_name, dim in [
@@ -222,13 +228,25 @@ class InferenceEngine:
             self.reader,
             dtype=dtype,
             put=shard_params_put(self.mesh, self.header),
-            weight_format=weight_format,
+            # q40i8 loads the wire's Q40 blocks first, then requantizes
+            weight_format="q40" if quantized else weight_format,
             # quantized path: fuse q|k|v (and w1|w3 for dense-FFN archs)
             # into single shard-major-interleaved kernel launches — 7 -> 4
             # Pallas calls per decode layer (~41 us fixed cost each,
             # docs/silicon_r03.md)
-            fuse=tp if weight_format == "q40" else 0,
+            fuse=tp if quantized else 0,
         )
+        self.i8_group = 0
+        if weight_format == "q40i8":
+            # grouped-int8 device format: native MXU integer dots instead
+            # of per-element VPU dequant (ops/int8_matmul.py) — the r4
+            # answer to the Q40 kernel's 46%-of-HBM-peak ceiling
+            from ..ops.int8_matmul import pick_group, requantize_params
+
+            self.i8_group = pick_group(self.header, tp)
+            self.params = requantize_params(
+                self.params, self.header, self.i8_group
+            )
         # Per-lane serving: lanes park their cache writes in padding rows
         # beyond seqLen while other lanes prefill/idle, so independent
         # requests can occupy the batch lanes at different positions.

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Round-4 silicon sweep: grouped-int8 MXU kernel vs the shipping Q40
+kernel on the engine's REAL launch shapes (8B, post qkv/w13 fusion).
+
+The r3 sweep showed the Q40 kernel is dequant-compute-bound (46% of HBM
+peak); ops/int8_matmul.py moves the arithmetic to native int8 MXU dots.
+This measures, per shape:
+
+  * shipping Q40 kernel (bn=256, bk=4096 default),
+  * grouped-int8 kernel across (group, bn, bk) neighborhoods,
+  * XLA dense bf16 matvec (floor),
+
+and prints ms/call + effective GB/s against each variant's actual HBM
+bytes. Timing: differenced on-device fori_loop iteration counts (fixed
+tunnel costs cancel; docs/silicon_r03.md "Measurement method").
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from dllama_tpu.parallel.mesh import enable_compilation_cache, reassert_platform
+
+reassert_platform()
+enable_compilation_cache()
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dllama_tpu.ops.int8_matmul import i8matmul_2d, quantize_acts
+from dllama_tpu.ops.quant_matmul import qmatmul_2d
+
+Q_BLOCK = 32
+
+# the 8B fused decode shapes the engine actually launches (m=1), plus a
+# lane batch
+SHAPES = [
+    ("qkv", 1, 4096, 6144),
+    ("wo", 1, 4096, 4096),
+    ("w13", 1, 4096, 28672),
+    ("w2", 1, 14336, 4096),
+    ("w13_m8", 8, 4096, 28672),
+]
+
+GROUPS = [256, 512, 1024]
+BLOCKS = [(256, 4096), (512, 4096), (256, 2048), (512, 2048), (1024, 4096),
+          (256, 8192)]
+
+
+def timed_loop(step, args, n_iter: int):
+    """ms/call via two differenced on-device fori_loop lengths.
+
+    `step(it, *args)` must run the op `it` times under fori_loop. The
+    operand arrays ride as jit ARGUMENTS (not closure constants) so XLA
+    cannot constant-fold the computation away."""
+    f = jax.jit(step, static_argnums=(0,))
+
+    def run(n):
+        out = f(n, *args)
+        _ = np.asarray(jax.device_get(jnp.ravel(out)[0]))  # full sync
+        t0 = time.perf_counter()
+        out = f(n, *args)
+        _ = np.asarray(jax.device_get(jnp.ravel(out)[0]))
+        return time.perf_counter() - t0
+
+    t_small = run(n_iter // 4)
+    t_big = run(n_iter)
+    return (t_big - t_small) * 1000.0 / (n_iter - n_iter // 4)
+
+
+def main() -> None:
+    print(f"devices: {jax.devices()}", flush=True)
+    rng = np.random.default_rng(0)
+    n_iter = int(os.environ.get("SWEEP_ITERS", "40"))
+
+    for name, m, k, n in SHAPES:
+        print(f"\n=== {name}: m={m} k={k} n={n} ===", flush=True)
+        x = jnp.asarray(rng.standard_normal((m, k)).astype(np.float32) * 0.3)
+        q40_q = jnp.asarray(rng.integers(-8, 8, size=(k, n), dtype=np.int8))
+        q40_d = jnp.asarray(
+            (rng.random((k // Q_BLOCK, n)) * 0.02 + 0.01).astype(np.float32)
+        )
+        q40_bytes = k * n + (k // Q_BLOCK) * n * 4
+
+        # floor: XLA dense bf16
+        wd = jnp.asarray(
+            (rng.standard_normal((k, n)) * 0.02).astype(np.float32)
+        ).astype(jnp.bfloat16)
+        xb = x.astype(jnp.bfloat16)
+
+        def dense_step(it, xb, wd):
+            def body(i, acc):
+                o = jnp.dot(xb, wd, preferred_element_type=jnp.float32)
+                return acc + o[0, 0]
+
+            return lax.fori_loop(0, it, body, jnp.float32(0))
+
+        try:
+            ms = timed_loop(dense_step, (xb, wd), n_iter)
+            gbs = 2.0 * k * n / ms / 1e6
+            print(f"  dense-bf16-xla: {ms:8.3f} ms  {gbs:6.0f} GB/s", flush=True)
+        except Exception as e:
+            print(f"  dense-bf16-xla: {type(e).__name__}: {str(e)[:100]}")
+
+        # shipping Q40 kernel
+        for bn, bk in [(256, 4096), (512, 4096)]:
+            bk = min(bk, k)
+            if n % bn or k % bk:
+                continue
+
+            def q40_step(it, x, q, d, bn=bn, bk=bk):
+                def body(i, acc):
+                    o = qmatmul_2d(x, q, d, block_n=bn, block_k=bk)
+                    return acc + o[0, 0]
+
+                return lax.fori_loop(0, it, body, jnp.float32(0))
+
+            try:
+                ms = timed_loop(q40_step, (x, q40_q, q40_d), n_iter)
+                gbs = q40_bytes / ms / 1e6
+                print(
+                    f"  q40 bn={bn} bk={bk}: {ms:8.3f} ms  {gbs:6.0f} GB/s",
+                    flush=True,
+                )
+            except Exception as e:
+                print(f"  q40 bn={bn} bk={bk}: {type(e).__name__}: {str(e)[:100]}")
+
+        # grouped-int8 kernel
+        for group in GROUPS:
+            if k % group:
+                continue
+            qi = jnp.asarray(rng.integers(-127, 128, size=(k, n), dtype=np.int8))
+            si = jnp.asarray(
+                (rng.random((k // group, n)) * 0.001 + 0.001).astype(np.float32)
+            )
+            xq, sx = quantize_acts(x, group)
+            xq = jax.device_put(xq)
+            sx = jax.device_put(sx)
+            i8_bytes = k * n + (k // group) * n * 4 + m * k + m * (k // group) * 4
+            seen = set()
+            for bn, bk in BLOCKS:
+                bk = min(bk, k)
+                if n % bn or k % bk or bk % group or (bn, bk) in seen:
+                    continue
+                seen.add((bn, bk))
+
+                def i8_step(it, xq, sx, qi, si, bn=bn, bk=bk):
+                    def body(i, acc):
+                        o = i8matmul_2d(
+                            xq, sx, qi, si, block_n=bn, block_k=bk
+                        )
+                        return acc + o[0, 0]
+
+                    return lax.fori_loop(0, it, body, jnp.float32(0))
+
+                try:
+                    ms = timed_loop(i8_step, (xq, sx, qi, si), n_iter)
+                    gbs = i8_bytes / ms / 1e6
+                    print(
+                        f"  i8 G={group} bn={bn} bk={bk}: {ms:8.3f} ms  "
+                        f"{gbs:6.0f} GB/s",
+                        flush=True,
+                    )
+                except Exception as e:
+                    print(
+                        f"  i8 G={group} bn={bn} bk={bk}: "
+                        f"{type(e).__name__}: {str(e)[:100]}"
+                    )
+
+
+if __name__ == "__main__":
+    main()
