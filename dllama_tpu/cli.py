@@ -333,12 +333,25 @@ def run_chat(args) -> None:
                 return False
             return True
 
-        out, _, _ = engine.generate(
-            tokens,
-            max_steps=engine.header.seq_len - 1 - pos,
-            on_token=on_token,
-            start_pos=pos,
-        )
+        try:
+            out, _, _ = engine.generate(
+                tokens,
+                max_steps=engine.header.seq_len - 1 - pos,
+                on_token=on_token,
+                start_pos=pos,
+            )
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:
+            # a failed dispatch dropped the donated KV cache
+            # (engine._cache_guard); the conversation context is gone, so
+            # restart the session instead of crashing the REPL (the
+            # reference's server retries whole-app init the same way,
+            # src/dllama-api.cpp:616-628 — its CLI just dies)
+            print(f"\n🚫 Generation failed ({e}); conversation reset.")
+            engine.reset()
+            pos, is_start = 0, True
+            continue
         pos += len(tokens) - 1 + len(out)
         print()
 

@@ -174,6 +174,39 @@ def _attention_tp(
     return out.reshape(b, t, n_heads * head_dim)
 
 
+def _attention_sp_merge(
+    qq: jnp.ndarray,  # [B, T, H, hd] — full queries, replicated over sp
+    kk: jnp.ndarray,  # [B, KH, S/sp, hd] — LOCAL sequence shard
+    vv: jnp.ndarray,
+    pos,  # scalar or [B] query positions (global coordinates)
+    sp_axis: str,
+    shard: int,
+) -> jnp.ndarray:
+    """Merged-stats sequence-parallel attention for callers ALREADY inside
+    a shard_map: each sp shard computes online-softmax partial state over
+    its local KV rows (global offset = shard index x shard), merged with a
+    log-sum-exp pmax/psum over `sp_axis`. Collective payload is
+    [B, KH, G, T](+hd) — tiny next to the cache reads it splits. Used by
+    the flat-mesh decode path (_attention_sp) and by run_layers' manual
+    sp mode inside pipeline stages (sp_axis). Returns [B, T, H, hd]."""
+    from ..ops.jnp_ops import attention_stats
+
+    idx = lax.axis_index(sp_axis)
+    acc, m, l = attention_stats(qq, kk, vv, pos, idx * shard)
+    m_g = lax.pmax(m, sp_axis)
+    scale = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - m_g))
+    l_g = lax.psum(l * scale, sp_axis)
+    acc_g = lax.psum(acc * scale[..., None], sp_axis)
+    l_safe = jnp.where(l_g == 0.0, 1.0, l_g)
+    out = acc_g / l_safe[..., None]  # [b, kh, g, t, hd]
+    bb, kh, g, tq, hd = out.shape
+    return (
+        out.transpose(0, 3, 1, 2, 4)
+        .reshape(bb, tq, kh * g, hd)
+        .astype(qq.dtype)
+    )
+
+
 def _attention_sp(
     q: jnp.ndarray,  # [B, T, H, hd]
     k_cache: jnp.ndarray,  # [B, KH, S, hd] — S sharded over "sp"
@@ -222,20 +255,7 @@ def _attention_sp(
         # Pallas local step (flash_decode_stats) buys nothing here
 
         def body(qq, kk, vv, pp):
-            idx = lax.axis_index("sp")
-            acc, m, l = attention_stats(qq, kk, vv, pp, idx * shard)
-            m_g = lax.pmax(m, "sp")
-            scale = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - m_g))
-            l_g = lax.psum(l * scale, "sp")
-            acc_g = lax.psum(acc * scale[..., None], "sp")
-            l_safe = jnp.where(l_g == 0.0, 1.0, l_g)
-            out = acc_g / l_safe[..., None]  # [b, kh, g, 1, hd]
-            bb, kh, g, tq, hd = out.shape
-            return (
-                out.transpose(0, 3, 1, 2, 4)
-                .reshape(bb, tq, kh * g, hd)
-                .astype(qq.dtype)
-            )
+            return _attention_sp_merge(qq, kk, vv, pp, "sp", shard)
 
     else:
         q_spec = P("dp", "sp", "tp", None)
@@ -691,6 +711,7 @@ def run_layers(
     moe_gather_max_tokens: int = 0,
     tp_axis: str | None = None,
     tp_n: int = 1,
+    sp_axis: str | None = None,
 ):
     """`lax.scan` the decoder layers over x; returns (x, k_new, v_new).
 
@@ -705,14 +726,30 @@ def run_layers(
     tp_n on the cache), kernels run locally, and col-split partial sums
     psum over `tp_axis` — the same collective placement qmatmul_tp's own
     shard_map produces on a flat mesh. Requires mesh=None.
+
+    `sp_axis`: MANUAL sequence parallelism (pp x sp): the caches arrive
+    as this shard's LOCAL sequence range (S/sp rows, global offset =
+    shard index x S/sp), queries stay full-width and replicated over the
+    axis. Attention is the merged-stats math (_attention_sp_merge) and
+    cache writes land only on the owning shard via a fixed-width window
+    update (a chunk may straddle two shards; each writes its overlap).
+    Requires mesh=None and T <= the local shard length.
     """
     b, t = x.shape[0], x.shape[1]
     interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
     act = silu if h.hidden_act == HiddenAct.SILU else gelu
     is_qwen3 = h.arch in (LlmArch.QWEN3, LlmArch.QWEN3_MOE)
     per_lane = jnp.ndim(pos) == 1
-    if tp_axis is not None and mesh is not None:
-        raise ValueError("manual tp (tp_axis) requires mesh=None")
+    if (tp_axis is not None or sp_axis is not None) and mesh is not None:
+        raise ValueError("manual tp/sp (tp_axis/sp_axis) requires mesh=None")
+    shard_s = k_cache.shape[3]  # local (per-sp-shard) sequence length
+    if sp_axis is not None and t > shard_s:
+        raise ValueError(
+            f"chunk width {t} exceeds the {shard_s}-row local sp shard"
+        )
+    sp_base = (
+        lax.axis_index(sp_axis) * shard_s if sp_axis is not None else None
+    )
     # per-shard head/out dims (tp_n=1 on the flat/GSPMD path)
     hq, hkv = h.n_heads // tp_n, h.n_kv_heads // tp_n
     # mesh tp size: per-shard shape checks (MoE kernel gate)
@@ -729,11 +766,35 @@ def run_layers(
         head-major cache's S axis, vmapped over lanes when positions
         differ. `val` arrives [B, T, KH, hd] from the projection."""
         val = val.astype(cache_l.dtype).transpose(0, 2, 1, 3)  # [B, KH, T, hd]
+        if sp_axis is not None:
+            return _cache_append_sp(cache_l, val)
         if per_lane:
             return jax.vmap(
                 lambda c, u, p: lax.dynamic_update_slice_in_dim(c, u, p, axis=1)
             )(cache_l, val, pos)
         return lax.dynamic_update_slice_in_dim(cache_l, val, pos, axis=2)
+
+    def _cache_append_sp(cache_l, val):
+        """Owning-shard window write for a sequence-sharded cache: global
+        positions `pos..pos+T` are mapped into this shard's local rows; a
+        T-row window at the clamped local start covers this shard's whole
+        overlap with the chunk (possibly empty), and per-row validity +
+        a T x T gather route each chunk row to its global slot. O(T rows)
+        per shard — no whole-slab select, no cross-shard collective."""
+
+        def write(c, u, p):  # c [KH, S_local, hd], u [KH, T, hd], p scalar
+            lstart = jnp.clip(p - sp_base, 0, shard_s - t)
+            cur = lax.dynamic_slice_in_dim(c, lstart, t, axis=1)
+            gpos = sp_base + lstart + jnp.arange(t, dtype=jnp.int32)
+            r = gpos - p  # chunk row belonging at each window row
+            ok = jnp.logical_and(r >= 0, r < t)
+            gathered = jnp.take(u, jnp.clip(r, 0, t - 1), axis=1)
+            upd = jnp.where(ok[None, :, None], gathered, cur)
+            return lax.dynamic_update_slice_in_dim(c, upd, lstart, axis=1)
+
+        if per_lane:
+            return jax.vmap(write)(cache_l, val, pos)
+        return jax.vmap(lambda c, u: write(c, u, pos))(cache_l, val)
 
     def layer_step(x, layer):
         lp, k_cache_l, v_cache_l = layer
@@ -776,12 +837,17 @@ def run_layers(
         k_cache_l = _cache_append(k_cache_l, k)
         v_cache_l = _cache_append(v_cache_l, v)
 
-        if attn_window and attn_window < k_cache_l.shape[2]:
+        if attn_window and attn_window < k_cache_l.shape[2] and sp_axis is None:
             k_view = k_cache_l[:, :, :attn_window]
             v_view = v_cache_l[:, :, :attn_window]
         else:
             k_view, v_view = k_cache_l, v_cache_l
-        z = _attention_tp(q, k_view, v_view, attn_pos, h.head_dim, mesh)
+        if sp_axis is not None:
+            z = _attention_sp_merge(
+                q, k_view, v_view, attn_pos, sp_axis, shard_s
+            ).reshape(b, t, hq * h.head_dim)
+        else:
+            z = _attention_tp(q, k_view, v_view, attn_pos, h.head_dim, mesh)
         x = x + mm(z, lp["wo"], "col", sync=True).astype(x.dtype)
 
         # -- FFN block (reference: src/llm.cpp:405-557) --

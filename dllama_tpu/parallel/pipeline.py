@@ -83,6 +83,7 @@ def forward_pp(
     logits_mode: str = "all",
     n_micro: int = 1,
     sync_quant: bool = False,
+    park_pos: int = 0,
 ):
     """Pipeline-parallel forward: same contract as models.forward.
 
@@ -93,8 +94,14 @@ def forward_pp(
     param_spec_tree), kernels run on the local slices, and the col-split
     partial sums / MoE outputs psum over "tp" INSIDE the stage
     (run_layers tp_axis) — pp x tp is how a 70B+ checkpoint outgrows the
-    tp <= nKvHeads ceiling: stages of tp groups. sp/dp composition is
-    future work. The manual partial-sum order differs from the flat
+    tp <= nKvHeads ceiling: stages of tp groups. A `dp` mesh axis
+    additionally shards the batch lanes inside every stage (tokens, pos,
+    cache batch axis, logits all dp-split): pp x dp is the pipeline's
+    throughput configuration — lockstep pp decode throughput is set by
+    concurrent lanes (docs/pp_decode_model.md), and dp multiplies lanes
+    without growing any single chip's batch. sp composition is handled
+    via manual stats-merge attention (sp_axis). The manual partial-sum
+    order differs from the flat
     mesh's single reduction, so low-precision (bf16) greedy streams can
     flip argmax near-ties on near-uniform logits — the same neutral
     divergence class any tensor-parallel partial summing has (f32 runs
@@ -110,6 +117,18 @@ def forward_pp(
     costs time; decode (T=1, weight-bandwidth-bound) keeps n_micro=1 —
     splitting lanes into groups would re-read the stage's weights per
     group and erase the batching win. Requires T % n_micro == 0.
+
+    `park_pos` > 0 routes INVALID ticks' cache writes into the lane-
+    padding rows at that index (the same scratch rows lane parking uses)
+    instead of select-merging the whole stage cache every tick. The
+    per-tick `jnp.where(valid, k_new, k_c)` reads+writes the stage's
+    entire [L/pp, B, KH, S, hd] cache — on an 8B/pp=4 layout that is
+    ~130 MB x2 moved per tick, comparable to the stage's weight read
+    itself — while the park write touches only T rows. Causality is
+    preserved because padding rows sit at indices > every real position,
+    so the causal mask already excludes them from attention (identical
+    to the engine's lane-parking argument). Requires the cache's S axis
+    to carry >= chunk-width padding beyond `park_pos`.
     """
     from jax import shard_map
 
@@ -122,11 +141,30 @@ def forward_pp(
 
     pp = mesh.shape["pp"]
     tp = mesh.shape.get("tp", 1)
+    sp = mesh.shape.get("sp", 1)
+    # dp: batch lanes shard over the dp axis INSIDE each stage — the
+    # pipeline's throughput lever (docs/pp_decode_model.md: lockstep pp
+    # decode throughput scales with concurrent lanes, and dp multiplies
+    # lanes without growing any one chip's batch). sp: the cache's
+    # sequence axis shards inside each stage; attention runs the manual
+    # merged-stats math (run_layers sp_axis).
     b, t = tokens.shape
     if t % n_micro != 0:
         raise ValueError(f"T={t} not divisible by n_micro={n_micro}")
     tc = t // n_micro
-    attn_pos = attn_positions(pos, attn_park_threshold, cache["k"].shape[3])
+    cache_s = cache["k"].shape[3]
+    if park_pos and park_pos + tc > cache_s:
+        # dynamic_update_slice clamps out-of-range starts silently, which
+        # would divert the scratch writes onto the LAST REAL ROWS — make
+        # the missing-padding case loud instead (the engine sizes the
+        # cache with >= max-bucket padding whenever pp > 1)
+        raise ValueError(
+            f"park_pos={park_pos} needs {tc} scratch rows but the cache "
+            f"sequence axis has only {cache_s} rows; allocate "
+            f">= park_pos + chunk width"
+        )
+    attn_pos = attn_positions(pos, attn_park_threshold, cache_s)
+    per_lane = jnp.ndim(pos) == 1
 
     layers = params["layers"]
     globals_ = {
@@ -134,6 +172,7 @@ def forward_pp(
         for k in ("embed", "wcls", "final_norm", "rope_cos", "rope_sin")
     }
 
+    sp_ax = "sp" if sp > 1 else None
     if tp > 1:
         # per-leaf pp x tp specs: leading layer axis over stages, row/col
         # matmul splits over the stage's tp group (the flat mesh's rules,
@@ -143,7 +182,7 @@ def forward_pp(
         all_specs = param_spec_tree(h)
         layer_specs = pp_param_specs(all_specs)["layers"]
         layers_spec = {k: layer_specs[k] for k in layers}
-        cache_spec = P("pp", "dp", "tp", None, None)
+        cache_spec = P("pp", "dp", "tp", sp_ax, None)
         # wcls keeps its vocab-axis tp shard (pp-replicated): each stage's
         # tp group computes its vocab slice and all-gathers inside the
         # body (logits_head tp_axis) — passing it replicated would
@@ -151,9 +190,14 @@ def forward_pp(
         globals_spec = {k: all_specs[k] for k in globals_}
     else:
         layers_spec = P("pp")  # prefix: leading (layer) axis of every leaf
-        cache_spec = P("pp")
+        cache_spec = P("pp", "dp", None, sp_ax, None)
         globals_spec = P()
     repl = P()
+    # batch lanes shard over dp inside each stage (specs work for dp=1
+    # too — the axis always exists on a pp mesh, parallel/mesh.make_mesh)
+    tok_spec = P("dp", None)
+    pos_spec = P("dp") if per_lane else P()
+    logits_spec = P("dp", None, None)
     ring = [(i, (i + 1) % pp) for i in range(pp)]
 
     # logits_mode="last" (every prefill/decode step) only consumes the
@@ -165,8 +209,9 @@ def forward_pp(
     def body(layers, k_c, v_c, globals_, tokens, pos, attn_pos):
         stage = lax.axis_index("pp")
         d = globals_["embed"].shape[-1]
-        x0 = jnp.zeros((b, tc, d), globals_["embed"].dtype)  # stage register
-        done0 = jnp.zeros((b, t if keep_all else tc, d), x0.dtype)
+        bl = tokens.shape[0]  # dp-local batch lanes
+        x0 = jnp.zeros((bl, tc, d), globals_["embed"].dtype)  # stage register
+        done0 = jnp.zeros((bl, t if keep_all else tc, d), x0.dtype)
 
         def tick_body(tick, carry):
             # stage s processes chunk c = tick - s this tick (when valid);
@@ -188,17 +233,27 @@ def forward_pp(
             c_safe = jnp.clip(c, 0, n_micro - 1)
             pos_c = pos + c_safe * tc
             attn_pos_c = attn_pos + c_safe * tc
+            if park_pos:
+                # invalid ticks write their (garbage) chunk into the
+                # padding scratch rows; real rows are untouched, so the
+                # O(stage cache) select below collapses to a no-op
+                pos_c = jnp.where(valid, pos_c, park_pos)
             cos, sin = rope_slices(globals_, pos_c, tc)
             x_out, k_new, v_new = run_layers(
                 x, layers, k_c, v_c, h, pos_c, attn_pos_c, cos, sin,
                 mesh=None, attn_window=attn_window,
                 sync_quant=sync_quant,
                 tp_axis="tp" if tp > 1 else None, tp_n=tp,
+                sp_axis=sp_ax,
             )
             # commit this stage's cache range only for a valid chunk;
-            # invalid ticks computed on pass-through/fill data
-            k_c = jnp.where(valid, k_new, k_c)
-            v_c = jnp.where(valid, v_new, v_c)
+            # invalid ticks computed on pass-through/fill data (park mode:
+            # their writes already landed in scratch rows)
+            if park_pos:
+                k_c, v_c = k_new, v_new
+            else:
+                k_c = jnp.where(valid, k_new, k_c)
+                v_c = jnp.where(valid, v_new, v_c)
             x = jnp.where(valid, x_out, x)
             # a chunk finishing the LAST stage exits into the output
             # register (every stage computes the update; only the last
@@ -237,10 +292,10 @@ def forward_pp(
         body,
         mesh=mesh,
         in_specs=(
-            layers_spec, cache_spec, cache_spec, globals_spec, repl, repl,
-            repl,
+            layers_spec, cache_spec, cache_spec, globals_spec, tok_spec,
+            pos_spec, pos_spec,
         ),
-        out_specs=(repl, cache_spec, cache_spec),
+        out_specs=(logits_spec, cache_spec, cache_spec),
         check_vma=False,
     )(layers, cache["k"], cache["v"], globals_, tokens, pos, attn_pos)
     return logits, {"k": k_new, "v": v_new}

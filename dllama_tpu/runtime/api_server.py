@@ -427,7 +427,28 @@ class ApiState:
     def complete(self, params: InferenceParams, emit) -> dict:
         """Run one chat completion; `emit(delta)` is called per text delta
         (streaming). Returns the non-stream response dict.
-        (reference: ApiServer::complete, src/dllama-api.cpp:367-487)"""
+        (reference: ApiServer::complete, src/dllama-api.cpp:367-487)
+
+        Crash consistency (single-stream analogue of the lane
+        scheduler's error path, and of the reference's 3 s whole-app
+        retry loop, src/dllama-api.cpp:616-628): a dispatch failure has
+        already dropped the engine's donated KV cache
+        (engine._cache_guard), so the positions recorded in the
+        NaiveCache no longer exist. The cache EPOCH is the exact
+        signal — any exception class can be raised inside a guarded
+        dispatch (even ValueError, at trace time), so "which exception"
+        does not tell us whether KV state survived; the epoch does.
+        Client-caused errors raised before any dispatch leave the
+        epoch, and therefore the prompt cache, untouched."""
+        epoch = self.engine.cache_epoch
+        try:
+            return self._complete(params, emit)
+        except BaseException:
+            if self.engine.cache_epoch != epoch:
+                self.naive_cache.clear()
+            raise
+
+    def _complete(self, params: InferenceParams, emit) -> dict:
         engine, tok = self.engine, self.tokenizer
         engine.temperature = params.temperature
         engine.sampler.set_temp(params.temperature)

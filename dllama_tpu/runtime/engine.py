@@ -155,14 +155,16 @@ class InferenceEngine:
             )
         # pipeline stages: layer ranges per stage (parallel/pipeline.py) —
         # the capacity axis past the reference's nNodes <= nKvHeads bound.
-        # Stage-local tp/sp composition is future work.
+        # Composes with tp (stages of tp groups), dp (lanes sharded inside
+        # stages) and sp (stage-local sequence shards, manual merged-stats
+        # attention).
         from ..parallel.pipeline import validate_pp
 
         validate_pp(self.header, pp)
-        if pp > 1 and (dp > 1 or sp > 1):
+        if pp > 1 and dp > 1 and batch_size % dp != 0:
             raise ValueError(
-                "pp composes with tp (stages of tp groups) but not yet "
-                "with dp/sp"
+                f"batch_size {batch_size} must divide over dp={dp} lanes "
+                "under pp"
             )
         self.mesh = make_mesh(tp=tp, dp=dp, sp=sp, pp=pp)
         self.tp, self.dp, self.sp, self.pp = tp, dp, sp, pp
@@ -178,7 +180,11 @@ class InferenceEngine:
         self.prefill_buckets = tuple(
             b
             for b in sorted(prefill_buckets)
-            if b <= self.header.seq_len and (sp == 1 or b == 1 or b % sp == 0)
+            if b <= self.header.seq_len
+            and (sp == 1 or b == 1 or b % sp == 0)
+            # pp x sp: stage-local sp writes are windowed per shard, so a
+            # chunk must fit one shard's local rows (run_layers sp_axis)
+            and (pp == 1 or sp == 1 or b <= self.header.seq_len // sp)
         ) or ((1,) if sp == 1 else (sp,))
 
         # "auto": keep Q40 weights quantized on device when the Pallas path
@@ -252,8 +258,11 @@ class InferenceEngine:
         # requests can occupy the batch lanes at different positions.
         # Padding must cover the widest chunk a parked lane "writes";
         # under sp it is rounded up so the padded sequence axis still
-        # tiles across the sp shards.
-        pad = max(self.prefill_buckets) if batch_size > 1 else 0
+        # tiles across the sp shards. Pipeline stages reuse the same
+        # scratch rows for INVALID-tick writes (parallel/pipeline.py
+        # park_pos): without padding every tick select-merges the whole
+        # stage cache, which costs as much HBM as the stage weight read.
+        pad = max(self.prefill_buckets) if (batch_size > 1 or pp > 1) else 0
         if pad and sp > 1:
             pad += (-pad) % sp
         self._lane_pad = pad
@@ -265,9 +274,7 @@ class InferenceEngine:
             ).items()
         }
         self.cache = self._fresh_cache()
-        self._token_sharding = NamedSharding(
-            self.mesh, P("dp", None) if pp == 1 else P(None, None)
-        )
+        self._token_sharding = NamedSharding(self.mesh, P("dp", None))
         self._compiled = {}
         self._base_key = jax.random.PRNGKey(seed)
         self._rng_calls = 0
@@ -281,6 +288,8 @@ class InferenceEngine:
         if pp > 1:
             from ..parallel.pipeline import forward_pp
 
+            park = self._park if self._lane_pad else 0
+
             def fwd(params, tokens, pos, cache, *, attn_window=0,
                     logits_mode="all", attn_park_threshold=0, n_micro=1):
                 return forward_pp(
@@ -288,6 +297,7 @@ class InferenceEngine:
                     attn_window=attn_window, logits_mode=logits_mode,
                     attn_park_threshold=attn_park_threshold,
                     n_micro=n_micro, sync_quant=sync_quant,
+                    park_pos=park,
                 )
 
         else:
@@ -319,6 +329,10 @@ class InferenceEngine:
     # -- cache ---------------------------------------------------------------
 
     def _fresh_cache(self):
+        # epoch lets callers detect that cached KV state was dropped
+        # (api_server clears its prompt cache iff this moved — a
+        # ValueError raised inside a guarded dispatch also rebuilds)
+        self.cache_epoch = getattr(self, "cache_epoch", -1) + 1
         cache = init_kv_cache(
             self.header,
             self.batch_size,
@@ -332,6 +346,26 @@ class InferenceEngine:
     def reset(self) -> None:
         """Drop KV state (new conversation)."""
         self.cache = self._fresh_cache()
+
+    @contextlib.contextmanager
+    def _cache_guard(self):
+        """Crash consistency for the donated KV cache: every compiled
+        step donates `self.cache` (donate_argnums), so a dispatch that
+        raises leaves the engine holding buffers in an unknown —
+        possibly already-donated — state, and the next call would fail
+        on them. Replace with a fresh cache before re-raising, so one
+        failed request costs its context but never wedges the engine
+        (the reference's analogue re-initializes the whole app every
+        3 s on executor errors, src/dllama-api.cpp:616-628; here params
+        are never donated, so only the cache needs rebuilding)."""
+        try:
+            yield
+        except BaseException as e:
+            try:
+                self.cache = self._fresh_cache()
+            except Exception as rebuild_err:  # pragma: no cover
+                raise rebuild_err from e
+            raise
 
     def set_seed(self, seed: int) -> None:
         """Reseed BOTH sampling paths (host xorshift sampler and the
@@ -477,16 +511,17 @@ class InferenceEngine:
         rng = jax.random.fold_in(
             jax.random.fold_in(self._base_key, pos), self._rng_calls
         )
-        out, self.cache = block(
-            self.params,
-            arr,
-            self.cache,
-            jnp.int32(pos),
-            rng,
-            jnp.float32(max(self.temperature, 1e-6)),
-            jnp.float32(self.sampler.topp),
-        )
-        out = np.asarray(out)  # [n_steps, lanes]
+        with self._cache_guard():
+            out, self.cache = block(
+                self.params,
+                arr,
+                self.cache,
+                jnp.int32(pos),
+                rng,
+                jnp.float32(max(self.temperature, 1e-6)),
+                jnp.float32(self.sampler.topp),
+            )
+            out = np.asarray(out)  # [n_steps, lanes]
         if per_lane:
             return [[int(t) for t in row] for row in out]
         return [int(t) for t in out[:, 0]]
@@ -578,10 +613,11 @@ class InferenceEngine:
             score = self._score_fn(
                 bucket, window=self._attn_window(p + bucket)
             )
-            part, self.cache = score(
-                self.params, arr, tgt, msk, self.cache, jnp.int32(p)
-            )
-            nll_sum += float(np.asarray(part))
+            with self._cache_guard():
+                part, self.cache = score(
+                    self.params, arr, tgt, msk, self.cache, jnp.int32(p)
+                )
+                nll_sum += float(np.asarray(part))
             p += width
         n_scored = t - 1
         nll = nll_sum / n_scored
@@ -661,7 +697,8 @@ class InferenceEngine:
             step = self._lane_prefill_fn(
                 bucket, window=self._attn_window(p + bucket)
             )
-            self.cache = step(self.params, arr, self.cache, pos_arr)
+            with self._cache_guard():
+                self.cache = step(self.params, arr, self.cache, pos_arr)
             p += width
 
     def _lane_decode_fn(self, n_steps: int, window: int = 0):
@@ -770,16 +807,17 @@ class InferenceEngine:
         rng = jax.random.fold_in(
             jax.random.fold_in(self._base_key, max(pos)), self._rng_calls
         )
-        out, self.cache = block(
-            self.params,
-            arr,
-            self.cache,
-            pos_arr,
-            act_arr,
-            rng,
-            jnp.asarray(temperature, jnp.float32),
-            jnp.asarray(topp, jnp.float32),
-        )
+        with self._cache_guard():
+            out, self.cache = block(
+                self.params,
+                arr,
+                self.cache,
+                pos_arr,
+                act_arr,
+                rng,
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(topp, jnp.float32),
+            )
         return [[int(t) for t in row] for row in np.asarray(out)]
 
     def _bucket_for(self, n: int, pos: int) -> int:
@@ -787,6 +825,12 @@ class InferenceEngine:
         in the cache (dynamic_update_slice clamps silently if pos+bucket >
         seqLen, which would corrupt earlier cache rows)."""
         space = self.header.seq_len - pos
+        if self.pp > 1 and self.sp > 1:
+            # stage-local sp writes are windowed per shard (run_layers
+            # sp_axis): no chunk may exceed one shard's local rows. The
+            # bucket filter enforces this for configured buckets; cap the
+            # fallback widths below the same way.
+            space = min(space, self.header.seq_len // self.sp)
         fitting = [b for b in self.prefill_buckets if b <= space]
         if not fitting:
             # guarded by the prefill bounds check: space >= 1 and bucket 1
@@ -798,7 +842,7 @@ class InferenceEngine:
                 space -= space % self.sp
                 if space == 0:
                     return 1
-            return space
+            return max(space, 1)
         for b in fitting:
             if n <= b:
                 return b
@@ -844,10 +888,13 @@ class InferenceEngine:
             # Padding tokens write garbage into cache slots [p+width,
             # p+bucket) — harmless: the causal mask hides them until real
             # tokens overwrite those positions.
-            _, self.cache = step(self.params, arr, self.cache, jnp.int32(p))
-            # scalar readback: a real sync (block_until_ready returns early
-            # on the tunneled axon TPU platform)
-            np.asarray(jax.device_get(self.cache["k"][0, 0, 0, 0, 0]))
+            with self._cache_guard():
+                _, self.cache = step(
+                    self.params, arr, self.cache, jnp.int32(p)
+                )
+                # scalar readback: a real sync (block_until_ready returns
+                # early on the tunneled axon TPU platform)
+                np.asarray(jax.device_get(self.cache["k"][0, 0, 0, 0, 0]))
             total_ms += (time.perf_counter() - t0) * 1000
             p += width
         return StepStats(time_ms=total_ms, n_tokens=max(n - 1, 0))
@@ -872,8 +919,9 @@ class InferenceEngine:
         greedy = self.temperature == 0.0
         step = self._step_fn(1, greedy=greedy, window=self._attn_window(pos + 1))
         t0 = time.perf_counter()
-        out, self.cache = step(self.params, arr, self.cache, jnp.int32(pos))
-        out = jax.block_until_ready(out)
+        with self._cache_guard():
+            out, self.cache = step(self.params, arr, self.cache, jnp.int32(pos))
+            out = jax.block_until_ready(out)
         ms = (time.perf_counter() - t0) * 1000
         if greedy:
             next_token = int(np.asarray(out)[0])

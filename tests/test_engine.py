@@ -437,3 +437,36 @@ def test_ici_traffic_accounts_pp():
     assert t_pp > 0  # tick hand-offs + exit psum
     # pp traffic is per-token tiny next to tp's per-layer all-reduces
     assert t_pp < ici_traffic_per_token(h, 2, include_logits=False)
+
+
+def test_cache_guard_recovers_from_failed_dispatch(tiny_model):
+    """Crash consistency (reference analogue: dllama-api's whole-app
+    retry, src/dllama-api.cpp:616-628): a dispatch that raises AFTER
+    donating the KV cache must leave the engine usable — the guard swaps
+    in a fresh cache (epoch moves) and the next generate produces the
+    clean-engine token stream instead of a donated-buffer error."""
+    mp, _ = tiny_model
+    eng = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    clean, _, _ = eng.generate([1, 2, 3, 4], max_steps=12)
+    eng.reset()
+    epoch0 = eng.cache_epoch
+
+    real = eng._decode_block_fn
+
+    def poisoned(n_steps, greedy, window=0):
+        block = real(n_steps, greedy, window)
+
+        def bad(params, token, cache, pos, rng, temp, topp):
+            block(params, token, cache, pos, rng, temp, topp)  # donates
+            raise RuntimeError("injected dispatch failure")
+
+        return bad
+
+    eng._decode_block_fn = poisoned
+    with pytest.raises(RuntimeError, match="injected"):
+        eng.generate([1, 2, 3, 4], max_steps=12)
+    eng._decode_block_fn = real
+
+    assert eng.cache_epoch > epoch0  # the donated cache was replaced
+    again, _, _ = eng.generate([1, 2, 3, 4], max_steps=12)
+    assert again == clean
