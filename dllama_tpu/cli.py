@@ -72,6 +72,10 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                    help="q40 keeps weights block-quantized on device (Pallas kernel)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR")
+    p.add_argument("--sync-measure", default="auto", choices=["auto", "off"],
+                   help="measure per-step collective time via a short "
+                   "profiled re-run (multi-device greedy runs only; 'off' "
+                   "skips the extra warmup steps)")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -199,6 +203,7 @@ def run_inference(args) -> None:
     # here). The logits all-gather happens once per forward, the per-layer
     # all-reduces once per token.
     from .utils.telemetry import ici_traffic_per_token as _ici
+    from .utils.telemetry import measure_sync_ms
 
     # q80-compressed sync moves 1.125 B/elem (int8 + f32/32 scales);
     # exact f32 psum moves 4. The pp hand-offs always ride uncompressed
@@ -217,14 +222,39 @@ def run_inference(args) -> None:
         - per_tok_bytes
     )
 
+    # MEASURED sync (collective) time per step type — the reference's
+    # per-step sync clock (src/nn/nn-executor.cpp:158-163). Profiled
+    # once per step type by re-running the upcoming step at a fixed
+    # position (idempotent KV rewrites), then printed on every line;
+    # Sent/Recv stay the deterministic sharding-layout estimate (the
+    # reference counts actual socket bytes, nn-network.cpp:524-539 —
+    # on-chip collectives have no socket to count, so the estimate IS
+    # the traffic model). Greedy only: the sampled path's host RNG
+    # state would advance during measurement runs.
+    measure = (
+        engine.mesh.devices.size > 1
+        and not args.profile
+        and engine.temperature == 0.0
+        and getattr(args, "sync_measure", "auto") != "off"
+    )
+    sync_eval = sync_pred = None
+
     print(args.prompt)
     with profile(args.profile):
+        if measure:
+            # steps=1: ONE extra prefill (idempotent row rewrites), so
+            # TTFT pays 2x, not 4x; it also warms the compile, so the
+            # Eval ms below reports warm-program time
+            sync_eval = measure_sync_ms(
+                lambda: engine.prefill(tokens), steps=1
+            )
         eval_stats = engine.prefill(tokens)
         eval_kb = (
             per_tok_bytes * max(eval_stats.n_tokens, 1) + logits_bytes
         ) // 1024
+        eval_sync = f"{sync_eval:5.1f}" if sync_eval is not None else "    0"
         print(
-            f"🔷️ Eval{eval_stats.time_ms:5.0f} ms Sync    0 ms | "
+            f"🔷️ Eval{eval_stats.time_ms:5.0f} ms Sync{eval_sync} ms | "
             f"Sent{eval_kb:6d} kB Recv{eval_kb:6d} kB | "
             f"({eval_stats.n_tokens} tokens)"
         )
@@ -235,14 +265,22 @@ def run_inference(args) -> None:
         pred_ms = 0.0
         n_pred = 0
         while pos < max_pos:
+            if measure and sync_pred is None:
+                # rewriting the same row: the real step below repeats it
+                sync_pred = measure_sync_ms(
+                    lambda: engine.decode_step(token, pos)
+                )
             token, stats = engine.decode_step(token, pos)
             pos += 1
             pred_ms += stats.time_ms
             n_pred += 1
             piece = tok.decode(token)
             step_kb = (per_tok_bytes + logits_bytes) // 1024
+            pred_sync = (
+                f"{sync_pred:5.1f}" if sync_pred is not None else "    0"
+            )
             print(
-                f"🔶 Pred{stats.time_ms:5.0f} ms Sync    0 ms | "
+                f"🔶 Pred{stats.time_ms:5.0f} ms Sync{pred_sync} ms | "
                 f"Sent{step_kb:6d} kB Recv{step_kb:6d} kB | "
                 f"{piece if piece is not None else chr(126)}"
             )

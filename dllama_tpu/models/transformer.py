@@ -125,6 +125,7 @@ def _attention_tp(
     pos: jnp.ndarray,
     head_dim: int,
     mesh,
+    attn_window: int = 0,  # sp only: global window, sliced per sp shard
 ) -> jnp.ndarray:
     """Attention dispatch on TPU: XLA dense attention for T=1 decode over
     the (window-sliced) cache, the prefill flash kernel for T >= 8
@@ -147,7 +148,10 @@ def _attention_tp(
     b, t = q.shape[0], q.shape[1]
     per_lane = jnp.ndim(pos) == 1
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        return _attention_sp(q, k_cache, v_cache, pos, head_dim, mesh)
+        return _attention_sp(
+            q, k_cache, v_cache, pos, head_dim, mesh,
+            attn_window=attn_window,
+        )
     on_tpu = jax.default_backend() == "tpu"
     s = k_cache.shape[2]
     if on_tpu and t >= 8 and pick_flash_blocks(t, s) is not None:
@@ -176,23 +180,31 @@ def _attention_tp(
 
 def _attention_sp_merge(
     qq: jnp.ndarray,  # [B, T, H, hd] — full queries, replicated over sp
-    kk: jnp.ndarray,  # [B, KH, S/sp, hd] — LOCAL sequence shard
+    kk: jnp.ndarray,  # [B, KH, S/sp, hd] — LOCAL sequence shard (cyclic)
     vv: jnp.ndarray,
     pos,  # scalar or [B] query positions (global coordinates)
     sp_axis: str,
-    shard: int,
+    sp_n: int,
 ) -> jnp.ndarray:
     """Merged-stats sequence-parallel attention for callers ALREADY inside
     a shard_map: each sp shard computes online-softmax partial state over
-    its local KV rows (global offset = shard index x shard), merged with a
-    log-sum-exp pmax/psum over `sp_axis`. Collective payload is
-    [B, KH, G, T](+hd) — tiny next to the cache reads it splits. Used by
-    the flat-mesh decode path (_attention_sp) and by run_layers' manual
-    sp mode inside pipeline stages (sp_axis). Returns [B, T, H, hd]."""
+    its local KV rows, merged with a log-sum-exp pmax/psum over `sp_axis`.
+    Collective payload is [B, KH, G, T](+hd) — tiny next to the cache
+    reads it splits. Used by the flat-mesh decode path (_attention_sp)
+    and by run_layers' manual sp mode inside pipeline stages (sp_axis).
+
+    The sequence layout is CYCLIC: shard i's local row j holds global
+    position j*sp + i (strided key positions in the stats math). This is
+    what makes attention windows tile the sp axis — the live prefix
+    [0, pos] spreads evenly over shards, so a global window w (an sp*512
+    multiple) is exactly the local prefix [0, w/sp) on every shard; with
+    the contiguous block layout early shards are fully live and no
+    uniform static local slice can shrink reads (engine._attn_window).
+    Returns [B, T, H, hd]."""
     from ..ops.jnp_ops import attention_stats
 
     idx = lax.axis_index(sp_axis)
-    acc, m, l = attention_stats(qq, kk, vv, pos, idx * shard)
+    acc, m, l = attention_stats(qq, kk, vv, pos, idx, s_stride=sp_n)
     m_g = lax.pmax(m, sp_axis)
     scale = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - m_g))
     l_g = lax.psum(l * scale, sp_axis)
@@ -209,15 +221,18 @@ def _attention_sp_merge(
 
 def _attention_sp(
     q: jnp.ndarray,  # [B, T, H, hd]
-    k_cache: jnp.ndarray,  # [B, KH, S, hd] — S sharded over "sp"
+    k_cache: jnp.ndarray,  # [B, KH, S, hd] — S sharded over "sp", CYCLIC
     v_cache: jnp.ndarray,
     pos: jnp.ndarray,
     head_dim: int,
     mesh,
+    attn_window: int = 0,
 ) -> jnp.ndarray:
     """Sequence-parallel attention: the KV cache's sequence axis lives on
     the `sp` mesh axis (the long-context scaling axis the reference lacks —
-    SURVEY.md §5 marks SP/ring absent there).
+    SURVEY.md §5 marks SP/ring absent there), in the CYCLIC row order
+    (global position g at shard g % sp, local row g // sp — see
+    _attention_sp_merge for why this is the windowable layout).
 
     Decode (T=1): every sp shard computes online-softmax partial state over
     its local KV rows, merged with a log-sum-exp pmax/psum — the collective
@@ -227,21 +242,32 @@ def _attention_sp(
     lane's strongly negative sentinel masks it on every shard.
 
     Prefill (T % sp == 0): queries shard over sp too and the KV shards
-    rotate around the ring (parallel/ring_attention.ring_attention_local),
-    overlapping each hop's ppermute with the local block compute.
+    rotate around the ring (parallel/ring_attention.ring_attention_local,
+    cyclic mode), overlapping each hop's ppermute with the local compute.
+
+    `attn_window` (a multiple of sp) slices every shard's LOCAL prefix to
+    window/sp rows before attending — O(pos) decode reads on the
+    long-context axis, the same engine-window mechanism the sp=1 path
+    uses (VERDICT r3 item 5 closed).
 
     Heads stay tp-sharded inside the same shard_map — attention needs no
     tp collectives (reference: sliceMultiHeadAtt head independence)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from ..ops.jnp_ops import attention_stats
     from ..parallel.ring_attention import ring_attention_local
 
     b, t, n_heads = q.shape[0], q.shape[1], q.shape[2]
     s = k_cache.shape[2]
     sp = mesh.shape["sp"]
     shard = s // sp
+    w_loc = 0
+    if attn_window and attn_window < s:
+        if attn_window % sp:
+            raise ValueError(
+                f"attn_window {attn_window} must be a multiple of sp={sp}"
+            )
+        w_loc = attn_window // sp
     kv_spec = P("dp", "tp", "sp", None)
     per_lane = jnp.ndim(pos) == 1
     pos_spec = P("dp") if per_lane else P()
@@ -255,30 +281,29 @@ def _attention_sp(
         # Pallas local step (flash_decode_stats) buys nothing here
 
         def body(qq, kk, vv, pp):
-            return _attention_sp_merge(qq, kk, vv, pp, "sp", shard)
+            if w_loc:
+                kk, vv = kk[:, :, :w_loc], vv[:, :, :w_loc]
+            return _attention_sp_merge(qq, kk, vv, pp, "sp", sp)
 
     else:
         q_spec = P("dp", "sp", "tp", None)
-        # same auto-select as the ring_attention wrapper: the Pallas
-        # flash-stats local step on TPU when the per-shard shapes tile,
-        # else the dense jnp stats math
-        from ..ops.flash_attention import pick_flash_blocks
-
-        tq_local = t // sp
-        use_flash = (
-            jax.default_backend() == "tpu"
-            and pick_flash_blocks(tq_local, shard) is not None
-        )
+        # cyclic key layout -> jnp stats local step (the flash-stats
+        # kernel's masks assume contiguous keys; a strided-mask kernel is
+        # a ROADMAP item). Ring hops rotate only the windowed local
+        # prefix, shrinking ICI payloads with the window too.
 
         def body(qq, kk, vv, pp):
             idx = lax.axis_index("sp")
             tq = qq.shape[1]
+            if w_loc:
+                kk, vv = kk[:, :, :w_loc], vv[:, :, :w_loc]
             return ring_attention_local(
                 qq, kk, vv,
                 q_pos0=pp + idx * tq,
                 shard_size=jnp.int32(shard),
                 axis_name="sp",
-                use_flash=use_flash,
+                use_flash=False,
+                cyclic=True,
             )
 
     out = shard_map(
@@ -712,6 +737,7 @@ def run_layers(
     tp_axis: str | None = None,
     tp_n: int = 1,
     sp_axis: str | None = None,
+    sp_n: int = 1,
 ):
     """`lax.scan` the decoder layers over x; returns (x, k_new, v_new).
 
@@ -727,13 +753,14 @@ def run_layers(
     psum over `tp_axis` — the same collective placement qmatmul_tp's own
     shard_map produces on a flat mesh. Requires mesh=None.
 
-    `sp_axis`: MANUAL sequence parallelism (pp x sp): the caches arrive
-    as this shard's LOCAL sequence range (S/sp rows, global offset =
-    shard index x S/sp), queries stay full-width and replicated over the
-    axis. Attention is the merged-stats math (_attention_sp_merge) and
-    cache writes land only on the owning shard via a fixed-width window
-    update (a chunk may straddle two shards; each writes its overlap).
-    Requires mesh=None and T <= the local shard length.
+    `sp_axis`/`sp_n`: MANUAL sequence parallelism (pp x sp): the caches
+    arrive as this shard's LOCAL rows of the CYCLIC sequence layout
+    (local row j holds global position j*sp_n + shard index — the
+    layout that makes attention windows tile sp, _attention_sp_merge),
+    queries stay full-width and replicated over the axis. Attention is
+    the merged-stats math and cache writes land on owning shards via a
+    fixed-width window update + validity gather (a chunk's rows spread
+    over every shard). Requires mesh=None.
     """
     b, t = x.shape[0], x.shape[1]
     interleaved = h.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1)
@@ -743,13 +770,16 @@ def run_layers(
     if (tp_axis is not None or sp_axis is not None) and mesh is not None:
         raise ValueError("manual tp/sp (tp_axis/sp_axis) requires mesh=None")
     shard_s = k_cache.shape[3]  # local (per-sp-shard) sequence length
-    if sp_axis is not None and t > shard_s:
-        raise ValueError(
-            f"chunk width {t} exceeds the {shard_s}-row local sp shard"
-        )
-    sp_base = (
-        lax.axis_index(sp_axis) * shard_s if sp_axis is not None else None
-    )
+    # manual sp: the per-shard write window is t//sp_n (+1 for unaligned
+    # chunk starts) local rows, capped at the whole local shard — a
+    # capped window starts at 0 and still covers any chunk's overlap
+    sp_win = min(t // sp_n + 1, shard_s) if sp_axis is not None else 0
+    sp_idx = lax.axis_index(sp_axis) if sp_axis is not None else None
+    # flat GSPMD path over an sp mesh: same cyclic layout, permuted
+    # whole-axis indices (shard g%sp holds global row g at local g//sp,
+    # i.e. axis index (g%sp)*shard_rows + g//sp)
+    _sp_mesh = mesh.shape.get("sp", 1) if mesh is not None else 1
+    _shard_rows = k_cache.shape[3] // _sp_mesh
     # per-shard head/out dims (tp_n=1 on the flat/GSPMD path)
     hq, hkv = h.n_heads // tp_n, h.n_kv_heads // tp_n
     # mesh tp size: per-shard shape checks (MoE kernel gate)
@@ -768,29 +798,61 @@ def run_layers(
         val = val.astype(cache_l.dtype).transpose(0, 2, 1, 3)  # [B, KH, T, hd]
         if sp_axis is not None:
             return _cache_append_sp(cache_l, val)
+        if _sp_mesh > 1:
+            return _cache_append_cyclic(cache_l, val)
         if per_lane:
             return jax.vmap(
                 lambda c, u, p: lax.dynamic_update_slice_in_dim(c, u, p, axis=1)
             )(cache_l, val, pos)
         return lax.dynamic_update_slice_in_dim(cache_l, val, pos, axis=2)
 
+    def _cache_append_cyclic(cache_l, val):
+        """Flat-mesh sp write in the cyclic layout: global row g lives at
+        axis index (g % sp) * shard_rows + g // sp. T == 1 stays a single
+        dynamic_update_slice at the permuted index; T > 1 scatters the
+        chunk's rows to their permuted indices (GSPMD routes each row to
+        its owning shard)."""
+
+        def perm(g):
+            return (g % _sp_mesh) * _shard_rows + g // _sp_mesh
+
+        if t == 1:
+            if per_lane:
+                return jax.vmap(
+                    lambda c, u, p: lax.dynamic_update_slice_in_dim(
+                        c, u, perm(p), axis=1
+                    )
+                )(cache_l, val, pos)
+            return lax.dynamic_update_slice_in_dim(
+                cache_l, val, perm(pos), axis=2
+            )
+        rows = jnp.arange(t, dtype=jnp.int32)
+        if per_lane:
+            return jax.vmap(
+                lambda c, u, p: c.at[:, perm(p + rows)].set(u)
+            )(cache_l, val, pos)
+        return cache_l.at[:, :, perm(pos + rows)].set(val)
+
     def _cache_append_sp(cache_l, val):
-        """Owning-shard window write for a sequence-sharded cache: global
-        positions `pos..pos+T` are mapped into this shard's local rows; a
-        T-row window at the clamped local start covers this shard's whole
-        overlap with the chunk (possibly empty), and per-row validity +
-        a T x T gather route each chunk row to its global slot. O(T rows)
-        per shard — no whole-slab select, no cross-shard collective."""
+        """Owning-shard window write for the manual (pp x sp) path with
+        the CYCLIC layout: this shard's local row j holds global position
+        j*sp_n + sp_idx, so a chunk [p, p+T) touches a contiguous local
+        range of <= T//sp_n + 1 rows; a fixed sp_win-row window at the
+        clamped local start covers the whole overlap, per-row validity +
+        a gather route each chunk row to its slot. O(T/sp rows) per
+        shard — no whole-slab select, no cross-shard collective."""
 
         def write(c, u, p):  # c [KH, S_local, hd], u [KH, T, hd], p scalar
-            lstart = jnp.clip(p - sp_base, 0, shard_s - t)
-            cur = lax.dynamic_slice_in_dim(c, lstart, t, axis=1)
-            gpos = sp_base + lstart + jnp.arange(t, dtype=jnp.int32)
+            jstart = jnp.clip(
+                (p - sp_idx + sp_n - 1) // sp_n, 0, shard_s - sp_win
+            )
+            cur = lax.dynamic_slice_in_dim(c, jstart, sp_win, axis=1)
+            gpos = (jstart + jnp.arange(sp_win, dtype=jnp.int32)) * sp_n + sp_idx
             r = gpos - p  # chunk row belonging at each window row
             ok = jnp.logical_and(r >= 0, r < t)
             gathered = jnp.take(u, jnp.clip(r, 0, t - 1), axis=1)
             upd = jnp.where(ok[None, :, None], gathered, cur)
-            return lax.dynamic_update_slice_in_dim(c, upd, lstart, axis=1)
+            return lax.dynamic_update_slice_in_dim(c, upd, jstart, axis=1)
 
         if per_lane:
             return jax.vmap(write)(cache_l, val, pos)
@@ -837,17 +899,40 @@ def run_layers(
         k_cache_l = _cache_append(k_cache_l, k)
         v_cache_l = _cache_append(v_cache_l, v)
 
-        if attn_window and attn_window < k_cache_l.shape[2] and sp_axis is None:
-            k_view = k_cache_l[:, :, :attn_window]
-            v_view = v_cache_l[:, :, :attn_window]
-        else:
-            k_view, v_view = k_cache_l, v_cache_l
         if sp_axis is not None:
+            # manual sp (cyclic layout): a global window (sp multiple) is
+            # the local prefix window/sp on every shard
+            if attn_window and attn_window % sp_n:
+                raise ValueError(
+                    f"attn_window {attn_window} must be a multiple of "
+                    f"sp={sp_n}"
+                )
+            w_rows = (
+                attn_window // sp_n
+                if attn_window and attn_window < shard_s * sp_n
+                else 0
+            )
+            k_view = k_cache_l[:, :, :w_rows] if w_rows else k_cache_l
+            v_view = v_cache_l[:, :, :w_rows] if w_rows else v_cache_l
             z = _attention_sp_merge(
-                q, k_view, v_view, attn_pos, sp_axis, shard_s
+                q, k_view, v_view, attn_pos, sp_axis, sp_n
             ).reshape(b, t, hq * h.head_dim)
         else:
-            z = _attention_tp(q, k_view, v_view, attn_pos, h.head_dim, mesh)
+            if (
+                attn_window
+                and attn_window < k_cache_l.shape[2]
+                and _sp_mesh == 1
+            ):
+                # flat non-sp: plain prefix slice; the sp mesh path
+                # windows inside _attention_sp (per-shard local prefix)
+                k_view = k_cache_l[:, :, :attn_window]
+                v_view = v_cache_l[:, :, :attn_window]
+            else:
+                k_view, v_view = k_cache_l, v_cache_l
+            z = _attention_tp(
+                q, k_view, v_view, attn_pos, h.head_dim, mesh,
+                attn_window=attn_window if _sp_mesh > 1 else 0,
+            )
         x = x + mm(z, lp["wo"], "col", sync=True).astype(x.dtype)
 
         # -- FFN block (reference: src/llm.cpp:405-557) --

@@ -146,6 +146,7 @@ def attention_stats(
     v: jnp.ndarray,  # [B, KH, Ts, hd]
     q_pos0,  # scalar or [B]: absolute position of q[:, 0] (per lane)
     s_pos0,  # scalar: absolute position of k[:, :, 0]
+    s_stride: int = 1,  # position step between consecutive key rows
 ):
     """Causal GQA attention partial state (unnormalized acc, running max m,
     denominator l) in f32 — the single source of the reference's
@@ -168,7 +169,10 @@ def attention_stats(
     scores = jnp.einsum("btkgh,bksh->bkgts", qf, kf) / jnp.sqrt(jnp.float32(hd))
     q_pos0_arr = jnp.atleast_1d(jnp.asarray(q_pos0, jnp.int32))  # [1] or [B]
     q_pos = q_pos0_arr[:, None] + jnp.arange(tq, dtype=jnp.int32)[None, :]
-    s_pos = s_pos0 + jnp.arange(ts, dtype=jnp.int32)
+    # s_stride > 1: CYCLIC sequence layout — local key row j holds the
+    # global position s_pos0 + j*stride (sp shard of a strided cache;
+    # see parallel/sharding.cache_specs / docs on sp windows)
+    s_pos = s_pos0 + jnp.arange(ts, dtype=jnp.int32) * s_stride
     mask = s_pos[None, None, :] <= q_pos[:, :, None]  # [1 or B, tq, ts]
     scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
     m = jnp.max(scores, axis=-1)  # [b, kh, g, tq]

@@ -244,7 +244,7 @@ def forward_pp(
                 mesh=None, attn_window=attn_window,
                 sync_quant=sync_quant,
                 tp_axis="tp" if tp > 1 else None, tp_n=tp,
-                sp_axis=sp_ax,
+                sp_axis=sp_ax, sp_n=sp,
             )
             # commit this stage's cache range only for a valid chunk;
             # invalid ticks computed on pass-through/fill data (park mode:

@@ -40,18 +40,21 @@ from ..ops.jnp_ops import attention_stats as _stats_jnp
 
 
 def _local_attention_stats(
-    q, k, v, q_pos0, s_pos0, use_flash: bool = False, interpret: bool = False
+    q, k, v, q_pos0, s_pos0, use_flash: bool = False, interpret: bool = False,
+    s_stride: int = 1,
 ):
     """Per-shard causal-GQA partial state: the Pallas flash-stats kernel when
     requested (TPU hot path — blockwise, no [Tq, Ss] score buffer), else the
-    shared jnp math (ops/jnp_ops.attention_stats)."""
-    if use_flash:
+    shared jnp math (ops/jnp_ops.attention_stats). `s_stride` > 1 (cyclic
+    sequence layouts) is jnp-only — the flash kernel's mask math assumes
+    contiguous key positions."""
+    if use_flash and s_stride == 1:
         from ..ops.flash_attention import flash_attention_stats
 
         return flash_attention_stats(
             q, k, v, q_pos0, s_pos0, interpret=interpret
         )
-    return _stats_jnp(q, k, v, q_pos0, s_pos0)
+    return _stats_jnp(q, k, v, q_pos0, s_pos0, s_stride=s_stride)
 
 
 def _merge_stats(acc1, m1, l1, acc2, m2, l2):
@@ -78,17 +81,27 @@ def ring_attention_local(
     axis_name: str = "sp",
     use_flash: bool = False,
     interpret: bool = False,
+    cyclic: bool = False,
 ) -> jnp.ndarray:
     """Per-shard ring attention body; call under shard_map with the sequence
-    axis of q/k/v sharded over `axis_name`. Returns [B, Tq, H, hd]."""
+    axis of q/k/v sharded over `axis_name`. Returns [B, Tq, H, hd].
+
+    `cyclic`: the KV shards use the cyclic sequence layout (shard i's row
+    j holds global position j*sp + i — the layout that lets attention
+    windows tile sp shards, see engine._attn_window): key positions of
+    the shard owned by `owner` are then owner + arange*sp instead of the
+    contiguous owner*shard_size + arange. Forces the jnp stats path (the
+    flash kernel's masks assume contiguous keys)."""
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
+    stride = sp if cyclic else 1
 
     def step(carry, _):
         k_cur, v_cur, owner, acc, m, l = carry
-        s_pos0 = owner * shard_size
+        s_pos0 = owner if cyclic else owner * shard_size
         acc2, m2, l2 = _local_attention_stats(
-            q, k_cur, v_cur, q_pos0, s_pos0, use_flash, interpret
+            q, k_cur, v_cur, q_pos0, s_pos0, use_flash, interpret,
+            s_stride=stride,
         )
         acc, m, l = _merge_stats(acc, m, l, acc2, m2, l2)
         # rotate KV one hop: chip i sends to chip (i+1) % sp, so the shard
@@ -113,7 +126,9 @@ def ring_attention_local(
         carry, _ = lax.scan(step, carry, None, length=sp - 1)
     k_last, v_last, owner, acc, m, l = carry
     acc2, m2, l2 = _local_attention_stats(
-        q, k_last, v_last, q_pos0, owner * shard_size, use_flash, interpret
+        q, k_last, v_last, q_pos0,
+        owner if cyclic else owner * shard_size,
+        use_flash, interpret, s_stride=stride,
     )
     acc, m, l = _merge_stats(acc, m, l, acc2, m2, l2)
 

@@ -378,20 +378,26 @@ class InferenceEngine:
 
     def _attn_window(self, limit: int) -> int:
         """Smallest power-of-2 window >= limit (min 512) covering the live
-        cache prefix; full seq_len when nothing smaller fits; 0 (= no
-        slicing) under sp. One compiled program per window keeps decode
-        reads proportional to the context actually used instead of the
-        allocated seq_len — O(pos) decode reads live HERE, not in a
-        kernel: round-3 silicon showed Mosaic does not elide repeated-
-        index DMAs, and windowed XLA dense attention beats the Pallas
-        decode kernel (scripts/decode_probe.py)."""
+        cache prefix; full seq_len when nothing smaller fits. One
+        compiled program per window keeps decode reads proportional to
+        the context actually used instead of the allocated seq_len —
+        O(pos) decode reads live HERE, not in a kernel: round-3 silicon
+        showed Mosaic does not elide repeated-index DMAs, and windowed
+        XLA dense attention beats the Pallas decode kernel
+        (scripts/decode_probe.py).
+
+        Under sp the cache uses the CYCLIC sequence layout (global row g
+        on shard g % sp at local row g // sp — models/transformer), so a
+        window that is an sp x 512 tile is exactly the 512-row local
+        prefix of every shard: the live context spreads evenly and
+        windowed O(pos) reads survive on the long-context axis (r3
+        returned 0 here, re-reading the whole per-shard cache)."""
         s = self.header.seq_len
         if self.sp > 1:
-            # windowing would slice the sp-sharded sequence axis out of
-            # alignment (and, with lane padding, mid-shard), so sp runs
-            # read the full per-shard cache each step (1/sp of the global
-            # cache)
-            return 0
+            w = 512 * self.sp
+            while w < limit:
+                w *= 2
+            return min(w, s)
         w = 512
         while w < limit:
             w *= 2

@@ -131,6 +131,68 @@ def ici_traffic_per_token(
     return int(total)
 
 
+_COLLECTIVE_MARKERS = (
+    "all-reduce", "allreduce", "all-gather", "allgather", "reduce-scatter",
+    "reducescatter", "collective-permute", "collectivepermute", "all-to-all",
+    "alltoall",
+)
+
+
+def measure_sync_ms(run_fn, steps: int = 3) -> float | None:
+    """MEASURED per-call collective (sync) wall time — the counterpart of
+    the reference's per-step sync clock (src/nn/nn-executor.cpp:158-163,
+    printed per token by dllama.cpp:59-66). The reference wraps its
+    socket waits in a timer; under XLA the collectives are fused into the
+    compiled program, so the measurement comes from the profiler instead:
+    run `run_fn()` `steps` times under `jax.profiler.trace`, parse the
+    perfetto trace, and sum the durations of collective HLO events
+    (all-reduce / all-gather / reduce-scatter / collective-permute /
+    all-to-all) across device lanes, averaged over devices and calls.
+
+    Returns ms per call per device, or None when the profile contains no
+    trace (profiler unavailable). `run_fn` must block until the step
+    really finished (readback), and must be IDEMPOTENT on engine state —
+    callers re-run the upcoming step at a fixed position (rewriting the
+    same KV rows), so the measurement does not perturb the stream."""
+    import glob
+    import gzip
+    import json
+    import os
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        try:
+            with jax.profiler.trace(d):
+                for _ in range(steps):
+                    run_fn()
+        except Exception:
+            return None
+        files = glob.glob(
+            os.path.join(d, "**", "*.trace.json.gz"), recursive=True
+        )
+        total_us = 0.0
+        pids = set()
+        found = False
+        for f in files:
+            try:
+                with gzip.open(f, "rt") as fh:
+                    trace = json.load(fh)
+            except Exception:
+                continue
+            for ev in trace.get("traceEvents", []):
+                if ev.get("ph") != "X":
+                    continue
+                found = True
+                name = str(ev.get("name", "")).lower()
+                if any(m in name for m in _COLLECTIVE_MARKERS):
+                    total_us += float(ev.get("dur", 0.0))
+                    pids.add(ev.get("pid", 0))
+        if not found:
+            return None
+        n_lanes = max(len(pids), 1)
+        return total_us / 1000.0 / steps / n_lanes
+
+
 @contextlib.contextmanager
 def profile(log_dir: str | None):
     """jax.profiler trace scope; no-op when log_dir is falsy."""

@@ -331,3 +331,118 @@ def test_qmatmul_tp_row_fused_shard_map(monkeypatch):
         np.testing.assert_allclose(
             np.asarray(part), np.asarray(expect), rtol=1e-5, atol=1e-5
         )
+
+
+def test_engine_sp_windowed_decode_parity(tmp_path):
+    """sp=2 with a seq_len large enough that decode windows engage
+    (window = 512*sp < seq_len): the cyclic cache layout must keep exact
+    token parity with the single-device engine across the window."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from helpers import make_tiny_model
+    from dllama_tpu.formats import FloatType
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=4, n_kv_heads=2,
+               head_dim=16, vocab_size=256, seq_len=2048)
+    mp = str(tmp_path / "mw.m")
+    make_tiny_model(mp, weight_type=FloatType.Q40, seed=21, cfg=cfg)
+    prompt = [(i * 7) % 250 + 1 for i in range(9)]
+    e1 = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    assert e1._attn_window(10) == 512
+    expected, _, _ = e1.generate(prompt, max_steps=24)
+    del e1
+    esp = InferenceEngine(mp, tp=1, sp=2, dtype=jnp.float32, temperature=0.0)
+    # the sp window is a 512-row local prefix per shard, not the full cache
+    assert esp._attn_window(10) == 1024 < cfg["seq_len"]
+    got, _, _ = esp.generate(prompt, max_steps=24)
+    del esp
+    assert got == expected, (got, expected)
+
+
+def test_sp_window_cuts_decode_bytes(tmp_path):
+    """VERDICT r3 item 5: per-step sp decode reads must be proportional
+    to the window, not seq_len — compiled bytes-accessed of a windowed
+    sp decode step is well below the unwindowed one."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from helpers import make_tiny_model
+    from dllama_tpu.formats import FloatType
+    from dllama_tpu.models import forward, init_kv_cache, load_params
+    from dllama_tpu.formats import ModelReader
+
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=4, n_kv_heads=2,
+               head_dim=16, vocab_size=256, seq_len=4096)
+    mp = str(tmp_path / "mb.m")
+    make_tiny_model(mp, weight_type=FloatType.Q40, seed=21, cfg=cfg)
+    r = ModelReader(mp)
+    h = r.header
+    params = load_params(r, weight_format="dense")
+    mesh = make_mesh(sp=2)
+    tok = jnp.asarray([[7]], jnp.int32)
+
+    def compiled_bytes(window):
+        cache = init_kv_cache(h, 1)
+
+        def step(p, t, c):
+            return forward(
+                p, h, t, jnp.int32(600), c, mesh=mesh, attn_window=window
+            )
+
+        cost = (
+            jax.jit(step, donate_argnums=(2,))  # engine donates the cache
+            .lower(params, tok, cache)
+            .compile()
+            .cost_analysis()
+        )
+        if isinstance(cost, list):
+            cost = cost[0]
+        return cost.get("bytes accessed", 0.0)
+
+    b_1k = compiled_bytes(1024)
+    b_2k = compiled_bytes(2048)
+    b_full = compiled_bytes(0)
+    # the cache-read term must scale with the window: each 1024 rows of
+    # window are L x KH x 1024 x hd x 4B x {k,v} = 0.52 MB of reads
+    row_bytes = 2 * 2 * 16 * 4 * 2  # L * KH * hd * itemsize * (k+v)
+    step = 1024 * row_bytes
+    assert b_2k - b_1k > 0.8 * step, (b_1k, b_2k)
+    assert b_full - b_2k > 0.8 * 2 * step, (b_2k, b_full)  # full = 4096
+
+
+def test_measure_sync_ms_collectives():
+    """measure_sync_ms (the reference's per-step sync clock restated for
+    XLA, nn-executor.cpp:158-163): a psum-heavy program on the 8-device
+    mesh reports nonzero collective time; a collective-free program
+    reports ~0."""
+    from jax import shard_map
+    from dllama_tpu.utils.telemetry import measure_sync_ms
+
+    mesh = make_mesh(tp=8)
+    x = jnp.ones((8, 1024), jnp.float32)
+
+    def with_psum():
+        f = shard_map(
+            lambda v: jax.lax.psum(v @ v.T, "tp"),
+            mesh=mesh,
+            in_specs=P("tp", None),
+            out_specs=P(None, None),
+            check_vma=False,
+        )
+        out = jax.jit(f)(x)
+        np.asarray(out)
+
+    def without():
+        out = jax.jit(lambda v: v * 2.0)(x)
+        np.asarray(out)
+
+    ms_with = measure_sync_ms(with_psum, steps=2)
+    ms_without = measure_sync_ms(without, steps=2)
+    if ms_with is None:
+        import pytest as _pytest
+
+        _pytest.skip("profiler trace unavailable on this backend")
+    assert ms_with > 0.0
+    assert (ms_without or 0.0) <= ms_with

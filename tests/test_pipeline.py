@@ -488,9 +488,15 @@ def test_forward_pp_x_sp_matches_single(tmp_path):
         np.testing.assert_allclose(
             np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-4
         )
+    # sp caches use the cyclic layout: global row g sits at axis index
+    # (g % sp) * shard + g // sp — undo the permutation before comparing
+    sp, shard = 2, s // 2
+    g = np.arange(s)
+    perm = (g % sp) * shard + g // sp
     for k in ("k", "v"):
         np.testing.assert_allclose(
-            np.asarray(cache_pp[k]), np.asarray(cache_ref[k]),
+            np.asarray(cache_pp[k])[:, :, :, perm],
+            np.asarray(cache_ref[k]),
             rtol=1e-5, atol=1e-5,
         )
 
