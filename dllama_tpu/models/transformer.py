@@ -965,7 +965,15 @@ def run_layers(
             if pallas_ok:
                 # decode-sized token counts take the per-(token, choice)
                 # ragged kernel; prefill-scale takes the grouped kernel
-                # (FLOPs proportional to selected experts, not all E)
+                # (FLOPs proportional to selected experts, not all E).
+                # Multi-lane decode DEDUP through the grouped kernel was
+                # investigated for r4 and rejected: a Pallas grid is
+                # static, so it must be sized for the all-distinct worst
+                # case (~m*k steps) and Mosaic does not elide the empty
+                # steps' repeated-index DMAs (docs/silicon_r03.md) — the
+                # schedule collapses *compute* per unique expert but not
+                # HBM reads. Analysis + the viable lax.cond two-tier
+                # design: docs/moe_decode_dedup.md.
                 moe_kernel_fn = (
                     _moe_ffn_pallas
                     if b * t <= MOE_PALLAS_MAX_TOKENS

@@ -317,15 +317,19 @@ def _grouped_schedule(top_i, weights, n_tokens, n_experts):
 
     Returns (t_sorted [A_pad], w_col [A_pad, 1], step_lo/hi/tile/expert
     [G]) where A_pad pads the A = N*k sorted assignments to the row tile
-    and G = A_pad/R + E + 1 statically bounds the (tile, segment) pairs —
-    every extra distinct expert inside a tile adds one step, and there are
-    at most E+1 distinct ids (incl. the padding sentinel)."""
+    and G = A_pad/R + min(E, A) + 1 statically bounds the (tile, segment)
+    pairs — every extra distinct expert inside a tile adds one step, and
+    there are at most min(E, A)+1 distinct ids (incl. the padding
+    sentinel). The min(E, A) term matters at DECODE scale: lane batches
+    have A = m*k << E assignments, and the old E+1 bound would append ~E
+    empty grid steps that each still DMA an expert tile (Mosaic does not
+    elide repeated-index block loads — docs/silicon_r03.md)."""
     n, k = top_i.shape
     a = n * k
     r = _GROUP_ROWS
     a_pad = -(-a // r) * r
     n_tiles = a_pad // r
-    g_steps = n_tiles + n_experts + 1
+    g_steps = n_tiles + min(n_experts, a) + 1
 
     flat_e = top_i.reshape(-1)
     flat_t = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
