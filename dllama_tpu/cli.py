@@ -60,7 +60,10 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                         "--batch-size lanes")
     p.add_argument("--workers", nargs="*", default=None, help="alias for --tp: pass a chip count (host:port lists are a LAN-cluster concept)")
     p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
-    p.add_argument("--kv-dtype", default=None, choices=[None, "bf16", "f32"])
+    p.add_argument("--kv-dtype", default=None,
+                   choices=[None, "bf16", "f32", "int8"],
+                   help="int8 = per-row quantized KV cache (~2x capacity "
+                   "vs bf16; models/transformer.QuantKV)")
     from .tokenizer import CHAT_TEMPLATE_NAMES
 
     p.add_argument("--chat-template", default=None,
@@ -119,9 +122,7 @@ def load_engine(args):
     if not args.model or not args.tokenizer:
         raise SystemExit("--model and --tokenizer are required")
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
-    kv_dtype = None if args.kv_dtype is None else (
-        jnp.bfloat16 if args.kv_dtype == "bf16" else jnp.float32
-    )
+    kv_dtype = args.kv_dtype  # engine normalizes the name (incl. int8)
     tok = Tokenizer(args.tokenizer)
     tp = _resolve_tp(args)
     sp = getattr(args, "sp", 1) or 1

@@ -25,7 +25,7 @@ rejects blocking a size-1 head dim; see ops/flash_attention.py).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -105,13 +105,78 @@ def _split_fused(out: jnp.ndarray, tp: int, dims: tuple[int, ...]):
     return parts
 
 
+class QuantKV(NamedTuple):
+    """int8 KV cache tensor: per-row (position) symmetric quantization.
+
+    ``q`` int8 [..., S, hd]; ``s`` f32 [..., S, 1] per-row scales. The
+    trailing singleton keeps the scale tensor the same RANK as the
+    values, so every positional write strategy (plain / cyclic-sp /
+    owning-shard window) and every PartitionSpec applies to both leaves
+    unchanged. Scales never enter a Pallas kernel — the r3 blocker was
+    Mosaic's last-two-dims tiling rejecting a bare [.., S] scale row
+    (ROADMAP r3 item 8); here dequant happens in XLA at the attention
+    read (fused into the dot for the decode path; the flash prefill
+    kernel receives a materialized dense view, amortized over the
+    chunk's compute). Halves KV HBM vs bf16 (+1/(2*hd) scale overhead):
+    the long-context fit lever on top of the windowed reads."""
+
+    q: jnp.ndarray
+    s: jnp.ndarray
+
+    @property
+    def shape(self):  # value-tensor shape: callers index S via shape[i]
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+def quantize_kv_rows(val: jnp.ndarray):
+    """[..., T, hd] -> (int8 values, f32 [..., T, 1] scales): the shared
+    grouped symmetric quantizer (ops/int8_matmul.quantize_acts — the Q80
+    move) with one group per cache row, so the KV path and the int8
+    matmul path cannot drift."""
+    from ..ops.int8_matmul import quantize_acts
+
+    return quantize_acts(val.astype(jnp.float32), val.shape[-1])
+
+
+def dequant_kv(cache_l, dtype):
+    """Dense view of a cache leaf: QuantKV -> values * scales (XLA
+    fuses this into the consuming attention dot on the decode path);
+    plain arrays pass through."""
+    if isinstance(cache_l, QuantKV):
+        return (cache_l.q.astype(jnp.float32) * cache_l.s).astype(dtype)
+    return cache_l
+
+
+def _slice_kv(cache_l, w: int):
+    """Sequence-axis prefix slice of a cache leaf ([B, KH, S, hd] layout),
+    QuantKV-aware; w == 0 means the full view."""
+    if not w:
+        return cache_l
+    if isinstance(cache_l, QuantKV):
+        return QuantKV(cache_l.q[:, :, :w], cache_l.s[:, :, :w])
+    return cache_l[:, :, :w]
+
+
 def init_kv_cache(
     h: LlmHeader, batch_size: int, dtype=jnp.float32, seq_len: int | None = None
 ) -> KvCache:
     """Allocate the KV cache (reference allocates per-layer f32 k/v buffers,
-    src/llm.cpp:260-261)."""
+    src/llm.cpp:260-261). dtype jnp.int8 allocates the quantized layout
+    (QuantKV leaves)."""
     s = seq_len or h.seq_len
     shape = (h.n_layers, batch_size, h.n_kv_heads, s, h.head_dim)
+    if dtype == jnp.int8:
+        def leaf():
+            return QuantKV(
+                jnp.zeros(shape, jnp.int8),
+                jnp.ones(shape[:-1] + (1,), jnp.float32),
+            )
+
+        return {"k": leaf(), "v": leaf()}
     return {
         "k": jnp.zeros(shape, dtype=dtype),
         "v": jnp.zeros(shape, dtype=dtype),
@@ -148,10 +213,15 @@ def _attention_tp(
     b, t = q.shape[0], q.shape[1]
     per_lane = jnp.ndim(pos) == 1
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        # QuantKV rides into the sp shard_map quantized; the bodies
+        # slice their local window first, then dequant — so int8 + sp
+        # reads stay windowed AND int8-sized across the boundary
         return _attention_sp(
             q, k_cache, v_cache, pos, head_dim, mesh,
             attn_window=attn_window,
         )
+    k_cache = dequant_kv(k_cache, q.dtype)
+    v_cache = dequant_kv(v_cache, q.dtype)
     on_tpu = jax.default_backend() == "tpu"
     s = k_cache.shape[2]
     if on_tpu and t >= 8 and pick_flash_blocks(t, s) is not None:
@@ -281,8 +351,8 @@ def _attention_sp(
         # Pallas local step (flash_decode_stats) buys nothing here
 
         def body(qq, kk, vv, pp):
-            if w_loc:
-                kk, vv = kk[:, :, :w_loc], vv[:, :, :w_loc]
+            kk = dequant_kv(_slice_kv(kk, w_loc), qq.dtype)
+            vv = dequant_kv(_slice_kv(vv, w_loc), qq.dtype)
             return _attention_sp_merge(qq, kk, vv, pp, "sp", sp)
 
     else:
@@ -295,8 +365,8 @@ def _attention_sp(
         def body(qq, kk, vv, pp):
             idx = lax.axis_index("sp")
             tq = qq.shape[1]
-            if w_loc:
-                kk, vv = kk[:, :, :w_loc], vv[:, :, :w_loc]
+            kk = dequant_kv(_slice_kv(kk, w_loc), qq.dtype)
+            vv = dequant_kv(_slice_kv(vv, w_loc), qq.dtype)
             return ring_attention_local(
                 qq, kk, vv,
                 q_pos0=pp + idx * tq,
@@ -794,8 +864,20 @@ def run_layers(
         """Write the chunk at each lane's position (reference: OP_SHIFT,
         src/nn/nn-cpu-ops.cpp:1419-1441) -> dynamic_update_slice on the
         head-major cache's S axis, vmapped over lanes when positions
-        differ. `val` arrives [B, T, KH, hd] from the projection."""
-        val = val.astype(cache_l.dtype).transpose(0, 2, 1, 3)  # [B, KH, T, hd]
+        differ. `val` arrives [B, T, KH, hd] from the projection. An
+        int8 cache (QuantKV) quantizes the rows once here and routes
+        values and scales through the SAME positional writer (the scale
+        leaf's trailing singleton keeps ranks equal)."""
+        val = val.transpose(0, 2, 1, 3)  # [B, KH, T, hd]
+        if isinstance(cache_l, QuantKV):
+            qv, sv = quantize_kv_rows(val)
+            return QuantKV(
+                _positional_write(cache_l.q, qv),
+                _positional_write(cache_l.s, sv),
+            )
+        return _positional_write(cache_l, val.astype(cache_l.dtype))
+
+    def _positional_write(cache_l, val):
         if sp_axis is not None:
             return _cache_append_sp(cache_l, val)
         if _sp_mesh > 1:
@@ -901,7 +983,8 @@ def run_layers(
 
         if sp_axis is not None:
             # manual sp (cyclic layout): a global window (sp multiple) is
-            # the local prefix window/sp on every shard
+            # the local prefix window/sp on every shard; dequant AFTER
+            # slicing so int8 caches keep windowed, int8-sized reads
             if attn_window and attn_window % sp_n:
                 raise ValueError(
                     f"attn_window {attn_window} must be a multiple of "
@@ -912,25 +995,28 @@ def run_layers(
                 if attn_window and attn_window < shard_s * sp_n
                 else 0
             )
-            k_view = k_cache_l[:, :, :w_rows] if w_rows else k_cache_l
-            v_view = v_cache_l[:, :, :w_rows] if w_rows else v_cache_l
             z = _attention_sp_merge(
-                q, k_view, v_view, attn_pos, sp_axis, sp_n
+                q,
+                dequant_kv(_slice_kv(k_cache_l, w_rows), x.dtype),
+                dequant_kv(_slice_kv(v_cache_l, w_rows), x.dtype),
+                attn_pos, sp_axis, sp_n,
             ).reshape(b, t, hq * h.head_dim)
         else:
-            if (
+            # flat non-sp: plain prefix slice (QuantKV rides sliced-but-
+            # quantized into _attention_tp, which dequants at entry); the
+            # sp mesh path windows inside _attention_sp per shard
+            w_flat = (
                 attn_window
+                if attn_window
                 and attn_window < k_cache_l.shape[2]
                 and _sp_mesh == 1
-            ):
-                # flat non-sp: plain prefix slice; the sp mesh path
-                # windows inside _attention_sp (per-shard local prefix)
-                k_view = k_cache_l[:, :, :attn_window]
-                v_view = v_cache_l[:, :, :attn_window]
-            else:
-                k_view, v_view = k_cache_l, v_cache_l
+                else 0
+            )
             z = _attention_tp(
-                q, k_view, v_view, attn_pos, h.head_dim, mesh,
+                q,
+                _slice_kv(k_cache_l, w_flat),
+                _slice_kv(v_cache_l, w_flat),
+                attn_pos, h.head_dim, mesh,
                 attn_window=attn_window if _sp_mesh > 1 else 0,
             )
         x = x + mm(z, lp["wo"], "col", sync=True).astype(x.dtype)
