@@ -56,8 +56,12 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                    help="pipeline stages: each holds nLayers/pp layers + "
                         "that range's KV cache — fits models past the "
                         "tp <= nKvHeads ceiling; composes with --tp "
-                        "(stages of tp groups; chips = pp x tp) and "
-                        "--batch-size lanes")
+                        "(stages of tp groups; chips = pp x tp), --dp, "
+                        "--sp and --batch-size lanes")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel chips: batch lanes shard across "
+                        "dp (requires batch-size % dp == 0); the "
+                        "throughput axis for pp (docs/pp_decode_model.md)")
     p.add_argument("--workers", nargs="*", default=None, help="alias for --tp: pass a chip count (host:port lists are a LAN-cluster concept)")
     p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--kv-dtype", default=None,
@@ -125,6 +129,7 @@ def load_engine(args):
     kv_dtype = args.kv_dtype  # engine normalizes the name (incl. int8)
     tok = Tokenizer(args.tokenizer)
     tp = _resolve_tp(args)
+    dp = getattr(args, "dp", 1) or 1
     sp = getattr(args, "sp", 1) or 1
     pp = getattr(args, "pp", 1) or 1
     if pp > 1 and tp == 0:
@@ -132,7 +137,7 @@ def load_engine(args):
     if tp == 0:
         from .parallel.mesh import auto_tp
 
-        tp = auto_tp(args.model, n_devices=len(jax.devices()) // sp)
+        tp = auto_tp(args.model, n_devices=len(jax.devices()) // (sp * dp))
     # the reference's q80 sync compression pays on DCN (multi-host), not
     # ICI: honor the flag only when processes > 1 (parallel/collectives.py)
     buffer_ft = (
@@ -142,6 +147,7 @@ def load_engine(args):
         args.model,
         tokenizer=tok,
         tp=tp,
+        dp=dp,
         sp=sp,
         pp=pp,
         dtype=dtype,
@@ -169,8 +175,12 @@ def load_engine(args):
         print(f"💡 nActiveExperts: {h.n_active_experts}")
     print(f"💡 SeqLen: {h.seq_len}")
     print(f"💡 Tp: {tp} chip(s) [{jax.default_backend()}]")
+    if dp > 1:
+        print(f"💡 Dp: {dp} lane shards")
     if sp > 1:
         print(f"💡 Sp: {sp} sequence shards")
+    if pp > 1:
+        print(f"💡 Pp: {pp} pipeline stages")
     if tok.vocab_size != h.vocab_size:
         print(
             f"⚠️  tokenizer vocab ({tok.vocab_size}) != model vocab "
@@ -179,7 +189,9 @@ def load_engine(args):
     print(f"💡 WeightFormat: {engine.weight_format}")
     from .utils.telemetry import memory_report
 
-    memory_report(engine.params, engine.cache, n_devices=tp).print()
+    memory_report(
+        engine.params, engine.cache, n_devices=tp * dp * sp * pp
+    ).print()
     tok.print_header()
     return engine, tok
 

@@ -161,10 +161,9 @@ class InferenceEngine:
         from ..parallel.pipeline import validate_pp
 
         validate_pp(self.header, pp)
-        if pp > 1 and dp > 1 and batch_size % dp != 0:
+        if dp > 1 and batch_size % dp != 0:
             raise ValueError(
-                f"batch_size {batch_size} must divide over dp={dp} lanes "
-                "under pp"
+                f"batch_size {batch_size} must divide over dp={dp} lanes"
             )
         self.mesh = make_mesh(tp=tp, dp=dp, sp=sp, pp=pp)
         self.tp, self.dp, self.sp, self.pp = tp, dp, sp, pp
