@@ -357,10 +357,19 @@ def _attention_sp(
 
     else:
         q_spec = P("dp", "sp", "tp", None)
-        # cyclic key layout -> jnp stats local step (the flash-stats
-        # kernel's masks assume contiguous keys; a strided-mask kernel is
-        # a ROADMAP item). Ring hops rotate only the windowed local
-        # prefix, shrinking ICI payloads with the window too.
+        # cyclic key layout: the flash-stats local step handles strided
+        # key positions (ops/flash_attention s_stride), auto-selected on
+        # TPU when the per-shard shapes tile (int8 caches take the jnp
+        # path — dequant-then-kernel would materialize the dense copy).
+        # Ring hops rotate only the windowed local prefix, shrinking ICI
+        # payloads with the window too.
+        tq_local = t // sp
+        rows_local = w_loc or shard
+        use_flash = (
+            jax.default_backend() == "tpu"
+            and not isinstance(k_cache, QuantKV)
+            and pick_flash_blocks(tq_local, rows_local) is not None
+        )
 
         def body(qq, kk, vv, pp):
             idx = lax.axis_index("sp")
@@ -372,7 +381,7 @@ def _attention_sp(
                 q_pos0=pp + idx * tq,
                 shard_size=jnp.int32(shard),
                 axis_name="sp",
-                use_flash=False,
+                use_flash=use_flash,
                 cyclic=True,
             )
 

@@ -84,6 +84,7 @@ def _flash_stats_kernel(
     n_s: int,
     n_heads: int,
     scale: float,
+    s_stride: int = 1,
 ):
     """Like _flash_kernel but emits UNNORMALIZED online-softmax partial
     state (acc, m, l) — the drop-in local step for ring attention's
@@ -91,7 +92,11 @@ def _flash_stats_kernel(
     per LANE (pos_ref[b]); a lane position <= -T keeps EVERY query row of
     the chunk negative (the engine's parked lanes use -(cache length)),
     producing fully-masked stats at one block of DMA. A bare -1 would
-    only mask the first row of a multi-row chunk."""
+    only mask the first row of a multi-row chunk. `s_stride` > 1: the
+    key rows are a CYCLIC sequence shard (row j at global position
+    s_pos0 + j*stride — the windowable sp layout, see
+    models/transformer._attention_sp_merge); positions and the causal
+    frontier scale by the stride."""
     ti = pl.program_id(1)
     si = pl.program_id(2)
     q_pos0 = pos_ref[pl.program_id(0) // n_heads] + ti * block_t
@@ -103,7 +108,7 @@ def _flash_stats_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    s_start = s_pos0 + si * block_s
+    s_start = s_pos0 + si * block_s * s_stride
 
     @pl.when(s_start <= q_pos0 + block_t - 1)
     def _compute():
@@ -116,7 +121,9 @@ def _flash_stats_kernel(
             * scale
         )
         q_pos = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, (block_t, block_s), 0)
-        s_pos = s_start + jax.lax.broadcasted_iota(jnp.int32, (block_t, block_s), 1)
+        s_pos = s_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_t, block_s), 1
+        ) * s_stride
         scores = jnp.where(s_pos <= q_pos, scores, _NEG_INF)
         m_prev = m_ref[:, :1]
         m_cur = jnp.max(scores, axis=1, keepdims=True)
@@ -144,7 +151,7 @@ def _flash_stats_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block_t", "block_s", "interpret"),
+    static_argnames=("block_t", "block_s", "interpret", "s_stride"),
 )
 def flash_attention_stats(
     q: jnp.ndarray,  # [B, T, H, hd]
@@ -155,12 +162,16 @@ def flash_attention_stats(
     block_t: int = 0,
     block_s: int = 0,
     interpret: bool = False,
+    s_stride: int = 1,
 ):
     """Blockwise causal GQA attention partial state: returns f32
     (acc [B, KH, G, T, hd], m [B, KH, G, T], l [B, KH, G, T]) — the same
     contract as ops/jnp_ops.attention_stats, MXU-tiled. A vector q_pos0
     gives each lane its own query start (per-lane prefill); a strongly
-    negative lane position masks that lane entirely at one block of DMA."""
+    negative lane position masks that lane entirely at one block of DMA.
+    `s_stride` > 1 treats the key rows as a cyclic sequence shard (row j
+    at global position s_pos0 + j*stride) — the sp layout whose windows
+    tile shards; masks and the causal-frontier DMA clamp scale by it."""
     b, t, h, hd = q.shape
     kh, s = k.shape[1], k.shape[2]
     g = h // kh
@@ -197,9 +208,11 @@ def flash_attention_stats(
     def kv_map(bh, ti, si, pos_ref, spos_ref):
         # clamp past the causal frontier of this query tile (fully-masked
         # tiles re-fetch the frontier block: compute is skipped but Mosaic
-        # does not elide the repeated-index DMA — see module docstring)
+        # does not elide the repeated-index DMA — see module docstring);
+        # strided shards divide the frontier by the stride first
         limit = jnp.maximum(
             (pos_ref[bh // h] + (ti + 1) * block_t - 1 - spos_ref[0])
+            // s_stride
             // block_s,
             0,
         )
@@ -213,6 +226,7 @@ def flash_attention_stats(
             n_s=n_s,
             n_heads=h,
             scale=scale,
+            s_stride=s_stride,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,

@@ -45,14 +45,15 @@ def _local_attention_stats(
 ):
     """Per-shard causal-GQA partial state: the Pallas flash-stats kernel when
     requested (TPU hot path — blockwise, no [Tq, Ss] score buffer), else the
-    shared jnp math (ops/jnp_ops.attention_stats). `s_stride` > 1 (cyclic
-    sequence layouts) is jnp-only — the flash kernel's mask math assumes
-    contiguous key positions."""
-    if use_flash and s_stride == 1:
+    shared jnp math (ops/jnp_ops.attention_stats). Both backends support
+    `s_stride` > 1 (cyclic sequence layouts: key row j at position
+    s_pos0 + j*stride)."""
+    if use_flash:
         from ..ops.flash_attention import flash_attention_stats
 
         return flash_attention_stats(
-            q, k, v, q_pos0, s_pos0, interpret=interpret
+            q, k, v, q_pos0, s_pos0, interpret=interpret,
+            s_stride=s_stride,
         )
     return _stats_jnp(q, k, v, q_pos0, s_pos0, s_stride=s_stride)
 
@@ -90,8 +91,8 @@ def ring_attention_local(
     j holds global position j*sp + i — the layout that lets attention
     windows tile sp shards, see engine._attn_window): key positions of
     the shard owned by `owner` are then owner + arange*sp instead of the
-    contiguous owner*shard_size + arange. Forces the jnp stats path (the
-    flash kernel's masks assume contiguous keys)."""
+    contiguous owner*shard_size + arange. Both the jnp and flash-stats
+    local steps handle the stride."""
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     stride = sp if cyclic else 1

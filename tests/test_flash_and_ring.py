@@ -436,3 +436,68 @@ def test_moe_grouped_multilane_decode_parity():
     np.testing.assert_allclose(
         np.asarray(grouped), np.asarray(ragged), rtol=2e-2, atol=2e-2
     )
+
+
+def test_flash_stats_strided_matches_jnp():
+    """s_stride > 1 (cyclic sp shards: key row j at position
+    s_pos0 + j*stride): the flash-stats kernel's strided masks and
+    causal-frontier clamp must reproduce the jnp stats math for every
+    shard offset, including queries mid-shard and fully-masked shards."""
+    from dllama_tpu.ops.flash_attention import flash_attention_stats
+    from dllama_tpu.ops.jnp_ops import attention_stats
+
+    q, k, v = make_qkv(1, 16, 4, 2, 16, 32, seed=19)
+    for stride, s0, qpos in [(2, 0, 8), (2, 1, 8), (4, 3, 0), (2, 0, 50)]:
+        acc, m, l = flash_attention_stats(
+            q, k, v, jnp.int32(qpos), jnp.int32(s0),
+            block_t=8, block_s=8, interpret=True, s_stride=stride,
+        )
+        acc_r, m_r, l_r = attention_stats(
+            q, k, v, jnp.int32(qpos), jnp.int32(s0), s_stride=stride
+        )
+        mask = np.asarray(l_r) > 0
+        assert (np.asarray(l) > 0).tolist() == mask.tolist(), (stride, s0)
+        if mask.any():
+            o = np.asarray(acc) / np.maximum(np.asarray(l)[..., None], 1e-30)
+            o_r = np.asarray(acc_r) / np.maximum(
+                np.asarray(l_r)[..., None], 1e-30
+            )
+            np.testing.assert_allclose(
+                o[mask], o_r[mask], rtol=1e-5, atol=1e-5,
+                err_msg=f"stride={stride} s0={s0} qpos={qpos}",
+            )
+
+
+def test_ring_cyclic_flash_local_step():
+    """ring_attention_local in cyclic mode with the flash local step ==
+    jnp local step (interpret mode, 4 shards)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from dllama_tpu.parallel.ring_attention import ring_attention_local
+
+    b, t, h, kh, hd, sp = 1, 32, 4, 2, 16, 4
+    q, k, v = make_qkv(b, t, h, kh, hd, t, seed=23)
+    mesh = make_mesh(sp=sp)
+    shard = t // sp
+
+    def run(use_flash):
+        def body(qq, kk, vv):
+            idx = jax.lax.axis_index("sp")
+            return ring_attention_local(
+                qq, kk, vv, q_pos0=idx * (t // sp),
+                shard_size=jnp.int32(shard), axis_name="sp",
+                use_flash=use_flash, interpret=True, cyclic=True,
+            )
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, "sp", None, None), P(None, None, "sp", None),
+                      P(None, None, "sp", None)),
+            out_specs=P(None, "sp", None, None),
+            check_vma=False,
+        )(q, k, v)
+
+    np.testing.assert_allclose(
+        np.asarray(run(True)), np.asarray(run(False)),
+        rtol=1e-5, atol=1e-5,
+    )
