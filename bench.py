@@ -244,10 +244,14 @@ def main() -> None:
     tp = int(os.environ.get("BENCH_TP", "0")) or 1
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", "1024"))
     weight_format = os.environ.get("BENCH_FORMAT", "q40")
+    kv = os.environ.get("BENCH_KV", "bf16")  # bf16 | int8 (QuantKV)
+    if kv not in ("bf16", "int8"):
+        raise SystemExit(f"BENCH_KV must be bf16 or int8, got {kv!r}")
+    kv_dtype = jnp.int8 if kv == "int8" else jnp.bfloat16
 
     h = make_header(preset, max_seq_len=seq_len)
     log(f"bench: {preset}, tp={tp}, steps={steps}, seq_len={h.seq_len}, "
-        f"format={weight_format}, devices={jax.devices()}")
+        f"format={weight_format}, kv={kv}, devices={jax.devices()}")
 
     mesh = make_mesh(tp=tp)
     t0 = time.perf_counter()
@@ -256,7 +260,7 @@ def main() -> None:
         # fused qkv/w13 launches, like the engine's q40 default
         fuse=tp if weight_format in ("q40", "q40i8") else 0,
     )
-    cache = init_kv_cache(h, batch_size=1, dtype=jnp.bfloat16)
+    cache = init_kv_cache(h, batch_size=1, dtype=kv_dtype)
     cspecs = cache_specs(h)
     cache = {
         k: jax.device_put(v, NamedSharding(mesh, cspecs[k])) for k, v in cache.items()
@@ -315,6 +319,7 @@ def main() -> None:
         {
             "metric": (
                 f"decode_tok_s_per_chip_{preset.replace('-', '_')}_{weight_format}"
+                + ("_kv8" if kv == "int8" else "")
                 + ("_cpu_fallback" if os.environ.get("BENCH_CPU_FALLBACK") else "")
             ),
             "value": round(per_chip, 2),
@@ -357,7 +362,7 @@ def main() -> None:
     n_lanes = int(os.environ.get("BENCH_BATCH", "4"))
     if n_lanes > 1 and not os.environ.get("BENCH_CPU_FALLBACK"):
         del cache
-        cache_l = init_kv_cache(h, batch_size=n_lanes, dtype=jnp.bfloat16)
+        cache_l = init_kv_cache(h, batch_size=n_lanes, dtype=kv_dtype)
         cache_l = {
             k: jax.device_put(v, NamedSharding(mesh, cspecs[k]))
             for k, v in cache_l.items()

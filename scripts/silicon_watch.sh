@@ -30,6 +30,7 @@ run() { # name timeout_s cmd...
 run bench_8b_q40_fused 1800 env BENCH_PRESET=llama-8b BENCH_FORMAT=q40 python bench.py
 run sweep_r04_i8 2400 python scripts/sweep_r04_i8.py
 run bench_8b_q40i8 1800 env BENCH_PRESET=llama-8b BENCH_FORMAT=q40i8 python bench.py
+run bench_8b_q40i8_kv8 1800 env BENCH_PRESET=llama-8b BENCH_FORMAT=q40i8 BENCH_KV=int8 python bench.py
 run validate_engine 900 env TPU_VALIDATION_ONLY=engine python scripts/tpu_validation.py
 run validate_qmm_flash 1200 env TPU_VALIDATION_ONLY=qmm,flash python scripts/tpu_validation.py
 run sweep_r03b 2400 python scripts/sweep_r03b.py
