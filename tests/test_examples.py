@@ -9,6 +9,10 @@ import pytest
 
 from helpers import REPO_ROOT, make_tiny_model, make_tiny_tokenizer
 
+# heavyweight end-to-end surface: run with the full suite / CI;
+# deselect via -m 'not slow' for the fast local loop
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def tiny_pair(tmp_path_factory):

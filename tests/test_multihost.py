@@ -10,7 +10,13 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 from helpers import REPO_ROOT
+
+# heavyweight end-to-end surface: run with the full suite / CI;
+# deselect via -m 'not slow' for the fast local loop
+pytestmark = pytest.mark.slow
 
 _WORKER = r"""
 import sys

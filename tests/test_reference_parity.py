@@ -38,6 +38,10 @@ from dllama_tpu.tokenizer import Tokenizer
 
 from helpers import REPO_ROOT, make_tiny_model, make_tiny_tokenizer
 
+# heavyweight end-to-end surface: run with the full suite / CI;
+# deselect via -m 'not slow' for the fast local loop
+pytestmark = pytest.mark.slow
+
 REFERENCE = "/root/reference"
 BUILD_DIR = "/tmp/refbuild"  # session cache; the mount is immutable
 
