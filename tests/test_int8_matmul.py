@@ -200,3 +200,18 @@ def test_engine_q40i8_moe_keeps_expert_q40(tmp_path):
     assert isinstance(lp["wqkv"].weight, Int8Weight)
     out, _, _ = e.generate([1, 2, 3], max_steps=8)
     assert len(out) == 6  # max_steps - (prompt_len - 1)
+
+
+def test_engine_q40i8_pp_and_sp_parity(tmp_path):
+    """q40i8 composes with pipeline stages (Int8Weight leaves ride the
+    per-name pp x tp specs — q and s are both rank-3, so the same
+    PartitionSpec applies) and with sequence parallelism; token streams
+    match the q40i8 single-device run."""
+    e1 = _engine(tmp_path, tp=1, weight_format="q40i8")
+    expected, _, _ = e1.generate([5, 6, 7], max_steps=12)
+    del e1
+    for kw in (dict(pp=2), dict(sp=2), dict(pp=2, tp=2)):
+        e = _engine(tmp_path, weight_format="q40i8", **kw)
+        got, _, _ = e.generate([5, 6, 7], max_steps=12)
+        del e
+        assert got == expected, (kw, got, expected)
