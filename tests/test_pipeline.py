@@ -22,9 +22,9 @@ CFG4 = dict(dim=64, hidden_dim=160, n_layers=4, n_heads=4, n_kv_heads=2,
 TOKENS = [3, 17, 92, 5, 44, 120, 7, 3]
 
 
-def _params(tmp_path, weight_format="dense", fuse=0):
+def _params(tmp_path, weight_format="dense", fuse=0, cfg=None):
     path = str(tmp_path / "m.m")
-    make_tiny_model(path, weight_type=FloatType.Q40, seed=11, cfg=CFG4)
+    make_tiny_model(path, weight_type=FloatType.Q40, seed=11, cfg=cfg or CFG4)
     r = ModelReader(path)
     p = load_params(r, weight_format=weight_format, fuse=fuse)
     return r.header, p
@@ -372,12 +372,7 @@ def test_forward_pp_park_cuts_decode_bytes(tmp_path):
     select-merge path (the select reads+writes the whole stage cache
     every one of the pp ticks). Long seq_len so the cache term dominates
     the tiny model's weights, as it does at real scale."""
-    cfg = dict(CFG4, seq_len=512)
-    path = str(tmp_path / "mlong.m")
-    make_tiny_model(path, weight_type=FloatType.Q40, seed=11, cfg=cfg)
-    r = ModelReader(path)
-    params = load_params(r, weight_format="dense")
-    h = r.header
+    h, params = _params(tmp_path, cfg=dict(CFG4, seq_len=512))
     mesh = make_mesh(pp=4)
     s = h.seq_len
 
@@ -520,3 +515,34 @@ def test_engine_pp_x_sp_matches_single_device(tmp_path):
         got, _, _ = epp.generate(prompt, max_steps=18)
         del epp
         assert got == expected, (kw, got, expected)
+
+
+def test_forward_pp_x_sp_windowed_decode(tmp_path):
+    """pp x sp with an ACTIVE attention window (sp-multiple, smaller than
+    the cache): the manual-path local prefix slice must reproduce the
+    unwindowed logits while the window covers the live prefix."""
+    h, params = _params(tmp_path, cfg=dict(CFG4, seq_len=2048))
+    mesh = make_mesh(pp=2, sp=2)
+    cache0 = init_kv_cache(h, 1)
+
+    toks = jnp.asarray([TOKENS], jnp.int32)
+    _, cache = forward_pp(
+        params, h, toks, jnp.int32(0), cache0, mesh
+    )
+    step = jnp.asarray([[9]], jnp.int32)
+    lg_full, _ = forward_pp(
+        params, h, step, jnp.int32(len(TOKENS)), cache, mesh
+    )
+    lg_win, _ = forward_pp(
+        params, h, step, jnp.int32(len(TOKENS)), cache, mesh,
+        attn_window=1024,  # sp multiple, < 2048: local 512-row prefix
+    )
+    np.testing.assert_allclose(
+        np.asarray(lg_win), np.asarray(lg_full), rtol=1e-5, atol=1e-5
+    )
+    # misaligned windows fail loudly on the manual path too
+    with pytest.raises(ValueError, match="multiple of sp"):
+        forward_pp(
+            params, h, step, jnp.int32(len(TOKENS)), cache, mesh,
+            attn_window=1025,
+        )
