@@ -36,7 +36,12 @@ from ..ops.jnp_ops import apply_rope, gelu, qk_rms_norm, rms_norm, silu
 from ..ops.int8_matmul import Int8Weight, i8matmul_tp
 from ..ops.quant_matmul import QuantWeight, dequant, qmatmul_tp
 from ..ops.flash_attention import flash_attention, pick_flash_blocks
-from ..ops.moe_kernel import moe_active_experts, moe_active_experts_q40
+from ..ops.moe_kernel import (
+    moe_active_experts,
+    moe_active_experts_q40,
+    moe_grouped_experts,
+    moe_grouped_experts_q40,
+)
 
 Params = Dict[str, Any]
 KvCache = Dict[str, jnp.ndarray]
@@ -539,6 +544,7 @@ def _moe_ffn_pallas(
     mesh,
     interpret: bool = False,
     sync_quant: bool = False,
+    dedup: bool = False,
 ) -> jnp.ndarray:
     """Decode-step MoE via the ragged Pallas kernel (ops/moe_kernel.py):
     each token's top-k expert ids drive the HBM->VMEM DMA schedule, so only
@@ -553,21 +559,56 @@ def _moe_ffn_pallas(
     xf = x.reshape(n, d)
     top_i, weights = _moe_route(xf, gate_w, n_active)  # [n, k]
     quantized = isinstance(w1, QuantWeight)
+    # two-tier dedup (opt-in): when concurrent lanes share experts, a
+    # small-grid grouped kernel reads each UNIQUE expert's tiles once.
+    # The small grid must be sized statically BELOW the all-distinct
+    # worst case to beat the ragged kernel's A DMA steps (static grids
+    # pay empty steps' DMAs — docs/moe_decode_dedup.md), so a lax.cond
+    # on the runtime unique count picks between the compiled variants.
+    # The cap derives from the PER-SHARD token count (ii's local shape
+    # inside a dp shard_map), else dp runs would always "fit" a grid
+    # larger than their local ragged step count. Off by default pending
+    # routing-correlation data from real MoE checkpoints (uniform
+    # routing rarely satisfies u <= A/2).
+
+    def _maybe_two_tier(ii, ragged_fn, grouped_fn):
+        n_loc, k_loc = ii.shape
+        cap = (n_loc * k_loc) // 2 if dedup and n_loc > 1 else 0
+        if not cap:
+            return ragged_fn()
+        flat = jnp.sort(ii.reshape(-1))
+        u = 1 + jnp.sum(flat[1:] != flat[:-1])
+        return lax.cond(u <= cap, lambda: grouped_fn(cap), ragged_fn)
 
     if quantized:
         operands = (xf, w1.q, w1.d, w2.q, w2.d, w3.q, w3.d, top_i, weights)
 
         def run(xx, w1q, w1d, w2q, w2d, w3q, w3d, ii, wts):
-            return moe_active_experts_q40(
-                xx, w1q, w1d, w2q, w2d, w3q, w3d, ii, wts, interpret=interpret
+            return _maybe_two_tier(
+                ii,
+                lambda: moe_active_experts_q40(
+                    xx, w1q, w1d, w2q, w2d, w3q, w3d, ii, wts,
+                    interpret=interpret,
+                ),
+                lambda cap: moe_grouped_experts_q40(
+                    xx, w1q, w1d, w2q, w2d, w3q, w3d, ii, wts,
+                    interpret=interpret, max_segments=cap,
+                ).astype(jnp.float32),
             )
 
     else:
         operands = (xf, w1, w2, w3, top_i, weights)
 
         def run(xx, ww1, ww2, ww3, ii, wts):
-            return moe_active_experts(
-                xx, ww1, ww2, ww3, ii, wts, interpret=interpret
+            return _maybe_two_tier(
+                ii,
+                lambda: moe_active_experts(
+                    xx, ww1, ww2, ww3, ii, wts, interpret=interpret
+                ),
+                lambda cap: moe_grouped_experts(
+                    xx, ww1, ww2, ww3, ii, wts,
+                    interpret=interpret, max_segments=cap,
+                ).astype(jnp.float32),
             )
 
     if mesh is None or mesh.devices.size == 1:
@@ -692,6 +733,7 @@ def forward(
     attn_park_threshold: int = 0,
     logits_mode: str = "all",
     sync_quant: bool = False,
+    moe_decode_dedup: bool = False,
 ) -> Tuple[jnp.ndarray, KvCache]:
     """Run the decoder on T tokens starting at absolute position `pos`.
 
@@ -734,6 +776,7 @@ def forward(
         x, params["layers"], cache["k"], cache["v"], h, pos, attn_pos,
         cos, sin, mesh=mesh, attn_window=attn_window,
         sync_quant=sync_quant, moe_gather_max_tokens=moe_gather_max_tokens,
+        moe_decode_dedup=moe_decode_dedup,
     )
     logits = logits_head(x, params, h, mesh, logits_mode)
     return logits, {"k": k_new, "v": v_new}
@@ -813,6 +856,7 @@ def run_layers(
     attn_window: int = 0,
     sync_quant: bool = False,
     moe_gather_max_tokens: int = 0,
+    moe_decode_dedup: bool = False,
     tp_axis: str | None = None,
     tp_n: int = 1,
     sp_axis: str | None = None,
@@ -1069,15 +1113,17 @@ def run_layers(
                 # schedule collapses *compute* per unique expert but not
                 # HBM reads. Analysis + the viable lax.cond two-tier
                 # design: docs/moe_decode_dedup.md.
-                moe_kernel_fn = (
-                    _moe_ffn_pallas
-                    if b * t <= MOE_PALLAS_MAX_TOKENS
-                    else _moe_ffn_grouped
-                )
-                f = moe_kernel_fn(
-                    y, lp["moe_gate"], lp["w1"], lp["w2"], lp["w3"],
-                    h.n_active_experts, mesh, sync_quant=sync_quant,
-                )
+                if b * t <= MOE_PALLAS_MAX_TOKENS:
+                    f = _moe_ffn_pallas(
+                        y, lp["moe_gate"], lp["w1"], lp["w2"], lp["w3"],
+                        h.n_active_experts, mesh, sync_quant=sync_quant,
+                        dedup=moe_decode_dedup,
+                    )
+                else:
+                    f = _moe_ffn_grouped(
+                        y, lp["moe_gate"], lp["w1"], lp["w2"], lp["w3"],
+                        h.n_active_experts, mesh, sync_quant=sync_quant,
+                    )
             else:
                 moe = (
                     _moe_ffn_gather

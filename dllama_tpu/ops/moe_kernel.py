@@ -312,7 +312,8 @@ def moe_active_experts_q40(
 _GROUP_ROWS = 32  # row tile; worst-case wasted compute = E extra tiles
 
 
-def _grouped_schedule(top_i, weights, n_tokens, n_experts):
+def _grouped_schedule(top_i, weights, n_tokens, n_experts,
+                      max_segments: int | None = None):
     """jnp (traced) schedule for the grouped kernel.
 
     Returns (t_sorted [A_pad], w_col [A_pad, 1], step_lo/hi/tile/expert
@@ -323,13 +324,25 @@ def _grouped_schedule(top_i, weights, n_tokens, n_experts):
     sentinel). The min(E, A) term matters at DECODE scale: lane batches
     have A = m*k << E assignments, and the old E+1 bound would append ~E
     empty grid steps that each still DMA an expert tile (Mosaic does not
-    elide repeated-index block loads — docs/silicon_r03.md)."""
+    elide repeated-index block loads — docs/silicon_r03.md).
+
+    `max_segments` caps the expert-segment budget BELOW the worst case —
+    the two-tier decode dedup (docs/moe_decode_dedup.md) compiles a
+    small-grid variant and only dispatches it (lax.cond) when the
+    runtime unique-expert count fits; with more segments than the cap
+    the trailing scatter indices fall out of range and XLA drops them
+    (never executed: the caller's predicate guarantees the fit)."""
     n, k = top_i.shape
     a = n * k
     r = _GROUP_ROWS
     a_pad = -(-a // r) * r
     n_tiles = a_pad // r
-    g_steps = n_tiles + min(n_experts, a) + 1
+    seg_budget = (
+        min(n_experts, a)
+        if max_segments is None
+        else min(n_experts, a, max_segments)
+    )
+    g_steps = n_tiles + seg_budget + 1
 
     flat_e = top_i.reshape(-1)
     flat_t = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
@@ -430,7 +443,9 @@ def _grouped_w2_map(g, fi, lo, hi, tile, expert):
     return (expert[g], fi, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "max_segments")
+)
 def moe_grouped_experts(
     x: jnp.ndarray,  # [N, D] tokens (prefill-scale N)
     w1: jnp.ndarray,  # [E, D, F]
@@ -439,6 +454,7 @@ def moe_grouped_experts(
     top_i: jnp.ndarray,  # [N, k] int32
     weights: jnp.ndarray,  # [N, k] f32
     interpret: bool = False,
+    max_segments: int | None = None,
 ) -> jnp.ndarray:
     """Grouped active-expert SwiGLU MoE; [N, D] f32. See module section
     comment: assignments sorted by expert, one grid step per (row tile,
@@ -451,7 +467,7 @@ def moe_grouped_experts(
     r = _GROUP_ROWS
 
     t_s, w_col, lo, hi, tile, expert = _grouped_schedule(
-        top_i, weights, n, e
+        top_i, weights, n, e, max_segments=max_segments
     )
     a_pad = t_s.shape[0]
     g_steps = lo.shape[0]
@@ -541,7 +557,9 @@ def _grouped_kernel_q40(
         o_ref[:] = acc_ref[:]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "max_segments")
+)
 def moe_grouped_experts_q40(
     x: jnp.ndarray,  # [N, D]
     w1q: jnp.ndarray,  # [E, D, F] int8
@@ -553,6 +571,7 @@ def moe_grouped_experts_q40(
     top_i: jnp.ndarray,  # [N, k] int32
     weights: jnp.ndarray,  # [N, k] f32
     interpret: bool = False,
+    max_segments: int | None = None,
 ) -> jnp.ndarray:
     """Quantized grouped active-expert MoE (see moe_grouped_experts):
     selected experts' Q40 blocks stream once per overlapping row tile."""
@@ -563,7 +582,7 @@ def moe_grouped_experts_q40(
     r = _GROUP_ROWS
 
     t_s, w_col, lo, hi, tile, expert = _grouped_schedule(
-        top_i, weights, n, e
+        top_i, weights, n, e, max_segments=max_segments
     )
     a_pad = t_s.shape[0]
     g_steps = lo.shape[0]

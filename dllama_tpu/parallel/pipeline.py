@@ -84,6 +84,7 @@ def forward_pp(
     n_micro: int = 1,
     sync_quant: bool = False,
     park_pos: int = 0,
+    moe_decode_dedup: bool = False,
 ):
     """Pipeline-parallel forward: same contract as models.forward.
 
@@ -243,6 +244,7 @@ def forward_pp(
                 x, layers, k_c, v_c, h, pos_c, attn_pos_c, cos, sin,
                 mesh=None, attn_window=attn_window,
                 sync_quant=sync_quant,
+                moe_decode_dedup=moe_decode_dedup,
                 tp_axis="tp" if tp > 1 else None, tp_n=tp,
                 sp_axis=sp_ax, sp_n=sp,
             )

@@ -139,6 +139,7 @@ class InferenceEngine:
         matmul_precision: str | None = None,
         weight_format: str = "auto",
         buffer_float_type: str = "f32",
+        moe_decode_dedup: bool = False,
     ):
         self.reader = ModelReader(model_path, max_seq_len=max_seq_len)
         self.header: LlmHeader = self.reader.header
@@ -313,7 +314,7 @@ class InferenceEngine:
                     attn_window=attn_window, logits_mode=logits_mode,
                     attn_park_threshold=attn_park_threshold,
                     n_micro=n_micro, sync_quant=sync_quant,
-                    park_pos=park,
+                    park_pos=park, moe_decode_dedup=moe_decode_dedup,
                 )
 
         else:
@@ -326,6 +327,7 @@ class InferenceEngine:
                     attn_window=attn_window, logits_mode=logits_mode,
                     attn_park_threshold=attn_park_threshold,
                     sync_quant=sync_quant,
+                    moe_decode_dedup=moe_decode_dedup,
                 )
 
         self._fwd = fwd

@@ -522,3 +522,26 @@ def test_kv_dtype_name_validation(tiny_model):
     mp, _ = tiny_model
     with pytest.raises(ValueError, match="kv_dtype"):
         InferenceEngine(mp, kv_dtype="int4", dtype=jnp.float32)
+
+
+def test_engine_moe_decode_dedup_parity(tmp_path):
+    """moe_decode_dedup=True through the full engine (q40 experts,
+    4 concurrent lanes): per-lane streams match the default engine."""
+    from dllama_tpu.formats.model_file import LlmArch
+
+    mp = str(tmp_path / "moe.m")
+    make_tiny_model(mp, arch=LlmArch.QWEN3_MOE, weight_type=FloatType.Q40,
+                    seed=7)
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [2, 4]]
+    base = InferenceEngine(
+        mp, tp=1, dtype=jnp.float32, temperature=0.0, batch_size=4
+    )
+    expected = base.generate_batch(prompts, max_steps=10)
+    del base
+    eded = InferenceEngine(
+        mp, tp=1, dtype=jnp.float32, temperature=0.0, batch_size=4,
+        moe_decode_dedup=True,
+    )
+    got = eded.generate_batch(prompts, max_steps=10)
+    del eded
+    assert got == expected, (got, expected)

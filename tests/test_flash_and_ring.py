@@ -501,3 +501,46 @@ def test_ring_cyclic_flash_local_step():
         np.asarray(run(True)), np.asarray(run(False)),
         rtol=1e-5, atol=1e-5,
     )
+
+
+def test_moe_two_tier_dedup_matches_ragged():
+    """Opt-in two-tier decode dedup: with lanes sharing most experts the
+    lax.cond dispatches the small-grid grouped kernel; with distinct
+    experts it falls back to the ragged kernel — both must match the
+    always-ragged output. The test VERIFIES each regime really lands on
+    its branch (u vs the A/2 cap) so a predicate regression cannot pass
+    silently."""
+    from dllama_tpu.models.transformer import _moe_ffn_pallas, _moe_route
+
+    rng = np.random.default_rng(31)
+    E, D, F, K = 64, 64, 128, 3
+    w1, w2, w3, gate = _rand_moe(rng, E, D, F)
+    m = 8
+    cap = (m * K) // 2
+    # shared-expert regime: near-identical rows route identically
+    x_shared = jnp.asarray(
+        np.repeat(rng.standard_normal((1, 1, D)), m, axis=0).astype(
+            np.float32
+        )
+        + rng.standard_normal((m, 1, D)).astype(np.float32) * 1e-3
+    )
+    # diverse regime: independent rows over E=64 experts spread wide
+    x_div = jnp.asarray(rng.standard_normal((m, 1, D)).astype(np.float32))
+
+    def uniques(x):
+        ii, _ = _moe_route(x.reshape(m, D), gate, K)
+        return len(np.unique(np.asarray(ii)))
+
+    assert uniques(x_shared) <= cap, (uniques(x_shared), cap)
+    assert uniques(x_div) > cap, (uniques(x_div), cap)
+
+    for x in (x_shared, x_div):
+        base = _moe_ffn_pallas(
+            x, gate, w1, w2, w3, K, mesh=None, interpret=True
+        )
+        two = _moe_ffn_pallas(
+            x, gate, w1, w2, w3, K, mesh=None, interpret=True, dedup=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(two), np.asarray(base), rtol=2e-2, atol=2e-2
+        )
