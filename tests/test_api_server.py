@@ -492,3 +492,38 @@ def test_single_stream_crash_recovery(tmp_path):
         )
     finally:
         srv.shutdown()
+
+
+def test_chat_completion_q40i8_kv8_engine(tmp_path):
+    """Serving over the maximum-headroom decode configuration (grouped-
+    int8 weights + int8 KV cache): a greedy request completes and is
+    reproducible across two identical requests (NaiveCache prefix path
+    included). Hidden dims sized for the q40i8 group divisibility."""
+    mp, tp_ = str(tmp_path / "m8.m"), str(tmp_path / "t.t")
+    cfg = dict(dim=64, hidden_dim=256, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=288, seq_len=384)
+    make_tiny_model(mp, weight_type=FloatType.Q40, cfg=cfg)
+    make_tiny_tokenizer(tp_, chat_template="<|start_header_id|>")
+    tok = Tokenizer(tp_)
+    engine = InferenceEngine(
+        mp, tokenizer=tok, tp=1, dtype=jnp.float32, temperature=0.0,
+        seed=3, weight_format="q40i8", kv_dtype="int8",
+    )
+    assert engine.i8_group >= 32
+    srv = serve(engine, tok, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    payload = {
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 8,
+        "temperature": 0,
+    }
+    try:
+        with _post(url, payload) as r:
+            one = json.loads(r.read())["choices"][0]["message"]["content"]
+        with _post(url, payload) as r:
+            two = json.loads(r.read())["choices"][0]["message"]["content"]
+        assert one == two and isinstance(one, str)
+    finally:
+        srv.shutdown()

@@ -545,3 +545,27 @@ def test_engine_moe_decode_dedup_parity(tmp_path):
     got = eded.generate_batch(prompts, max_steps=10)
     del eded
     assert got == expected, (got, expected)
+
+
+def test_kv_int8_with_lanes_and_dp(tiny_model):
+    """int8 KV under continuous-batching lanes (and lanes sharded over
+    dp): per-lane streams match the single-lane int8 runs."""
+    mp, _ = tiny_model
+    prompts = [[1, 2, 3, 4], [9, 8, 7, 6, 5]]
+    singles = []
+    e1 = InferenceEngine(
+        mp, tp=1, dtype=jnp.float32, temperature=0.0, kv_dtype="int8"
+    )
+    for p in prompts:
+        e1.reset()
+        o, _, _ = e1.generate(p, max_steps=14)
+        singles.append(o)
+    del e1
+    for kw in (dict(), dict(dp=2)):
+        eb = InferenceEngine(
+            mp, dtype=jnp.float32, temperature=0.0, kv_dtype="int8",
+            batch_size=2, **kw,
+        )
+        outs = eb.generate_batch(prompts, max_steps=14)
+        del eb
+        assert outs == singles, (kw, outs, singles)
