@@ -27,11 +27,6 @@ import time
 from functools import partial
 
 import jax
-from dllama_tpu.parallel.mesh import enable_compilation_cache, reassert_platform
-
-reassert_platform()
-enable_compilation_cache()
-
 import jax.numpy as jnp
 import numpy as np
 
@@ -60,6 +55,35 @@ def weight_bytes_per_token(h, weight_format: str, i8_group: int = 512) -> int:
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def headline_record(
+    preset: str,
+    weight_format: str,
+    kv: str,
+    per_chip: float,
+    weight_gbs: float,
+    fallback: bool,
+) -> dict:
+    """The one-line headline metric. On CPU fallback the north-star ratio
+    is SUPPRESSED (`vs_baseline: null, comparable: false`) — a tunnel
+    outage must never produce a figure that pattern-matches a perf
+    datapoint in a dashboard; the raw value stays, honestly suffixed."""
+    return {
+        "metric": (
+            f"decode_tok_s_per_chip_{preset.replace('-', '_')}_{weight_format}"
+            + ("_kv8" if kv == "int8" else "")
+            + ("_cpu_fallback" if fallback else "")
+        ),
+        "value": round(per_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": (
+            None if fallback else round(per_chip / NORTH_STAR_TOK_S_PER_CHIP, 3)
+        ),
+        "comparable": not fallback,
+        "baseline_def": BASELINE_DEF,
+        "weight_gbs_per_chip": round(weight_gbs, 1),
+    }
 
 
 def _cpu_fallback_reexec(reason: str) -> None:
@@ -92,7 +116,8 @@ def _cpu_fallback_reexec(reason: str) -> None:
                 "metric": "decode_tok_s_per_chip_unavailable",
                 "value": 0.0,
                 "unit": "tokens/s/chip",
-                "vs_baseline": 0.0,
+                "vs_baseline": None,
+                "comparable": False,
                 "error": f"accelerator unreachable ({reason})",
             }
         )
@@ -180,7 +205,8 @@ def _arm_wall_watchdog() -> None:
             "metric": "bench_error",
             "value": 0.0,
             "unit": "tokens/s/chip",
-            "vs_baseline": 0.0,
+            "vs_baseline": None,
+            "comparable": False,
         }
         rec["error"] = f"wall watchdog fired after {wall_s:.0f}s (tunnel wedge mid-run)"
         print(json.dumps(rec), flush=True)
@@ -221,6 +247,16 @@ def _device_watchdog(timeout_s: float = 180.0) -> None:
 
 
 def main() -> None:
+    # platform/cache side effects live here, not at module level, so that
+    # importing bench (tests use headline_record) stays side-effect free
+    from dllama_tpu.parallel.mesh import (
+        enable_compilation_cache,
+        reassert_platform,
+    )
+
+    reassert_platform()
+    enable_compilation_cache()
+
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from dllama_tpu.models import forward, init_kv_cache
@@ -316,18 +352,14 @@ def main() -> None:
     # headline metric is banked the moment it exists: if a later section
     # (TTFT / lanes) wedges the tunnel, the wall watchdog emits this
     _partial_result.update(
-        {
-            "metric": (
-                f"decode_tok_s_per_chip_{preset.replace('-', '_')}_{weight_format}"
-                + ("_kv8" if kv == "int8" else "")
-                + ("_cpu_fallback" if os.environ.get("BENCH_CPU_FALLBACK") else "")
-            ),
-            "value": round(per_chip, 2),
-            "unit": "tokens/s/chip",
-            "vs_baseline": round(per_chip / NORTH_STAR_TOK_S_PER_CHIP, 3),
-            "baseline_def": BASELINE_DEF,
-            "weight_gbs_per_chip": round(weight_gbs, 1),
-        }
+        headline_record(
+            preset,
+            weight_format,
+            kv,
+            per_chip,
+            weight_gbs,
+            fallback=bool(os.environ.get("BENCH_CPU_FALLBACK")),
+        )
     )
 
     # p50 TTFT: prefill a 128-token prompt + first greedy token, one
@@ -408,7 +440,8 @@ if __name__ == "__main__":
                     "metric": "bench_error",
                     "value": 0.0,
                     "unit": "tokens/s/chip",
-                    "vs_baseline": 0.0,
+                    "vs_baseline": None,
+                    "comparable": False,
                     "error": f"{type(e).__name__}: {e}",
                 }
             )

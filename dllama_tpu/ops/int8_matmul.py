@@ -174,9 +174,14 @@ def i8matmul_2d(
     group = k // ng
     assert q.shape == (k, n) and sx.shape == (m, ng), (q.shape, sx.shape)
     bn = _pick_block(n, block_n)
-    bk = _pick_block(k, block_k)
-    if bk % group != 0:  # block must hold whole groups
-        bk = max(group, (bk // group) * group)
+    # The k block must divide k AND hold whole groups; search downward over
+    # group multiples for a divisor of k (group itself always qualifies:
+    # pick_group guarantees group | k).
+    bk = next(
+        b
+        for b in range(max(group, min(block_k, k) // group * group), 0, -group)
+        if k % b == 0
+    )
     assert k % bk == 0 and bk % group == 0, (k, bk, group)
     if s.dtype != jnp.float32:
         s = s.astype(jnp.float32)

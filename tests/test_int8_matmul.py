@@ -74,6 +74,24 @@ def test_kernel_matches_ref(group, block_k):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
+def test_kernel_awkward_k_block_alignment():
+    """k=11264 (22*512), group=512, block_k=4096: naive group-rounding of
+    the preferred block gives 2560, which does NOT divide k — the block
+    search must fall back to a group multiple that does (ADVICE r4)."""
+    rng = np.random.default_rng(11)
+    m, k, n, group = 2, 11264, 256, 512
+    w, _ = _q40(rng, k, n)
+    w8 = requantize_q40(w, group=group)
+    x = jnp.asarray(rng.standard_normal((m, k)).astype(np.float32))
+    xq, sx = quantize_acts(x, group)
+    got = np.asarray(
+        i8matmul_2d(xq, sx, w8.q, w8.s, block_n=256, block_k=4096,
+                    interpret=True)
+    )
+    want = np.asarray(i8matmul_ref(x, w8))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
 def test_i8matmul_leading_dims():
     rng = np.random.default_rng(5)
     k, n = 512, 256
