@@ -412,6 +412,95 @@ def test_sp_window_cuts_decode_bytes(tmp_path):
     assert b_full - b_2k > 0.8 * 2 * step, (b_2k, b_full)  # full = 4096
 
 
+def _scatter_operand_dims(hlo_text):
+    """Dims of every scatter op's operand in an HLO dump."""
+    import re
+
+    return [
+        [int(d) for d in m.group(1).split(",")]
+        for m in re.finditer(
+            r"= \w+\[([0-9,]+)\]\{[^}]*\} scatter\(", hlo_text
+        )
+    ]
+
+
+def test_cyclic_write_lowering_isolated():
+    """_cache_append_cyclic's T>1 scatter (transformer.py, the flat-GSPMD
+    sp write; VERDICT r4 #4) must partition into a SHARD-LOCAL scatter:
+    zero collectives, operand rows = S/sp not S. Mirrors the closure's
+    exact index math (perm(g) = (g%sp)*shard_rows + g//sp)."""
+    SP, B, KH, S, HD, T = 4, 1, 2, 4096, 64, 16
+    shard_rows = S // SP
+    mesh = make_mesh(sp=SP)
+    shard = NamedSharding(mesh, P(None, None, "sp", None))
+
+    def perm(g):
+        return (g % SP) * shard_rows + g // SP
+
+    rows = jnp.arange(T, dtype=jnp.int32)
+
+    def write(cache, val, pos):
+        return cache.at[:, :, perm(pos + rows)].set(val)
+
+    def write_per_lane(cache, val, pos):
+        return jax.vmap(lambda c, u, p: c.at[:, perm(p + rows)].set(u))(
+            cache, val, pos
+        )
+
+    cache = jax.device_put(jnp.zeros((B, KH, S, HD), jnp.float32), shard)
+    val = jnp.ones((B, KH, T, HD), jnp.float32)
+    for fn, pos in (
+        (write, jnp.int32(600)),
+        (write_per_lane, jnp.full((B,), 600, jnp.int32)),
+    ):
+        txt = (
+            jax.jit(fn, donate_argnums=(0,), out_shardings=shard)
+            .lower(cache, val, pos)
+            .compile()
+            .as_text()
+        )
+        for coll in ("all-gather", "all-to-all", "collective-permute",
+                     "all-reduce", "reduce-scatter", "collective-broadcast"):
+            assert coll not in txt, (fn.__name__, coll)
+        dims = _scatter_operand_dims(txt)
+        assert dims, f"{fn.__name__}: expected a scatter lowering"
+        for d in dims:
+            assert S not in d, (fn.__name__, d)  # not a full-S scatter
+            assert shard_rows in d, (fn.__name__, d)
+
+
+def test_cyclic_write_lowering_in_forward(tmp_path):
+    """Same pin on the REAL forward: a T>1 prefill chunk on an sp mesh
+    compiles with no all-to-all and only shard-local scatters (every
+    scatter operand carries the S/sp local row count, never full S)."""
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=4, n_kv_heads=2,
+               head_dim=16, vocab_size=256, seq_len=4096)
+    mp = str(tmp_path / "cyc.m")
+    make_tiny_model(mp, weight_type=FloatType.Q40, seed=23, cfg=cfg)
+    r = ModelReader(mp)
+    h = r.header
+    params = load_params(r, weight_format="dense")
+    mesh = make_mesh(sp=2)
+    cache = init_kv_cache(h, 1)
+    tok = jnp.ones((1, 16), jnp.int32)
+
+    def step(p, t, c):
+        return forward(p, h, t, jnp.int32(600), c, mesh=mesh)
+
+    txt = (
+        jax.jit(step, donate_argnums=(2,))
+        .lower(params, tok, cache)
+        .compile()
+        .as_text()
+    )
+    assert "all-to-all" not in txt
+    dims = _scatter_operand_dims(txt)
+    assert dims, "expected the cyclic cache write to lower to a scatter"
+    for d in dims:
+        assert cfg["seq_len"] not in d, d
+        assert cfg["seq_len"] // 2 in d, d
+
+
 def test_measure_sync_ms_collectives():
     """measure_sync_ms (the reference's per-step sync clock restated for
     XLA, nn-executor.cpp:158-163): a psum-heavy program on the 8-device
