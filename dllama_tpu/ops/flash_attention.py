@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .kv_cache import QuantKV
+
 _NEG_INF = -1e30
 
 
@@ -72,19 +74,16 @@ def _flash_stats_kernel(
     q_ref,  # [1, bt, hd]
     k_ref,  # [1, 1, bs, hd] — one head's (seq, hd) plane
     v_ref,  # [1, 1, bs, hd]
-    acc_out,  # [1, bt, hd]
-    m_out,  # [1, bt, 128]
-    l_out,  # [1, bt, 128]
-    m_ref,  # VMEM [bt, 128]
-    l_ref,  # VMEM [bt, 128]
-    acc_ref,  # VMEM [bt, hd]
-    *,
+    *rest,  # quant_kv: (ks_ref [1,1,bs,1], vs_ref [1,1,bs,1]); then
+    #         outputs (acc_out [1,bt,hd], m_out [1,bt,128], l_out
+    #         [1,bt,128]) and scratch (m_ref, l_ref, acc_ref)
     block_t: int,
     block_s: int,
     n_s: int,
     n_heads: int,
     scale: float,
     s_stride: int = 1,
+    quant_kv: bool = False,
 ):
     """Like _flash_kernel but emits UNNORMALIZED online-softmax partial
     state (acc, m, l) — the drop-in local step for ring attention's
@@ -96,7 +95,14 @@ def _flash_stats_kernel(
     key rows are a CYCLIC sequence shard (row j at global position
     s_pos0 + j*stride — the windowable sp layout, see
     models/transformer._attention_sp_merge); positions and the causal
-    frontier scale by the stride."""
+    frontier scale by the stride. `quant_kv`: k/v tiles arrive int8 with
+    per-row f32 scales as two extra [bs, 1]-blocked refs sharing the kv
+    index map — dequant happens HERE on the VMEM tile, so HBM traffic is
+    the int8 bytes (VERDICT r4 #3), amortized over the tile's bt queries."""
+    if quant_kv:
+        ks_ref, vs_ref, acc_out, m_out, l_out, m_ref, l_ref, acc_ref = rest
+    else:
+        acc_out, m_out, l_out, m_ref, l_ref, acc_ref = rest
     ti = pl.program_id(1)
     si = pl.program_id(2)
     q_pos0 = pos_ref[pl.program_id(0) // n_heads] + ti * block_t
@@ -114,6 +120,8 @@ def _flash_stats_kernel(
     def _compute():
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
+        if quant_kv:
+            k = k * ks_ref[0, 0]  # (bs, 1) per-row scales, lane-broadcast
         scores = (
             jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -135,6 +143,8 @@ def _flash_stats_kernel(
         alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         v = v_ref[0, 0].astype(jnp.float32)
+        if quant_kv:
+            v = v * vs_ref[0, 0]
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -171,7 +181,19 @@ def flash_attention_stats(
     negative lane position masks that lane entirely at one block of DMA.
     `s_stride` > 1 treats the key rows as a cyclic sequence shard (row j
     at global position s_pos0 + j*stride) — the sp layout whose windows
-    tile shards; masks and the causal-frontier DMA clamp scale by it."""
+    tile shards; masks and the causal-frontier DMA clamp scale by it.
+
+    `k`/`v` may be QuantKV (int8 values + f32 [.., S, 1] per-row scales):
+    the kernel then DMAs the int8 planes plus a [bs, 1]-blocked scale ref
+    and dequants on the VMEM tile — int8 prefill reads ~half the HBM
+    bytes of bf16 and never materializes a dense cache copy (the pre-r5
+    behavior; VERDICT r4 #3)."""
+    quant_kv = isinstance(k, QuantKV)
+    if isinstance(v, QuantKV) != quant_kv:
+        raise TypeError(
+            f"k and v must both be QuantKV or both dense, got "
+            f"k={type(k).__name__}, v={type(v).__name__}"
+        )
     b, t, h, hd = q.shape
     kh, s = k.shape[1], k.shape[2]
     g = h // kh
@@ -218,6 +240,25 @@ def flash_attention_stats(
         )
         return (bh // h, (bh % h) // g, jnp.minimum(si, limit), 0)
 
+    in_specs = [
+        pl.BlockSpec((1, block_t, hd), q_map),
+        pl.BlockSpec((1, 1, block_s, hd), kv_map),
+        pl.BlockSpec((1, 1, block_s, hd), kv_map),
+    ]
+    operands = [qt, k, v]
+    if quant_kv:
+        # scale refs ride the SAME index map as their value planes; the
+        # trailing dim is array-size 1 fully covered by the block (unlike
+        # the r3 blocker — a size-1 BLOCK of a larger dim in the last two
+        # dims — this tiles a genuine [.., S, 1] tensor)
+        in_specs = [
+            in_specs[0],
+            in_specs[1],
+            in_specs[2],
+            pl.BlockSpec((1, 1, block_s, 1), kv_map),
+            pl.BlockSpec((1, 1, block_s, 1), kv_map),
+        ]
+        operands = [qt, k.q, v.q, k.s, v.s]
     acc, m, l = pl.pallas_call(
         functools.partial(
             _flash_stats_kernel,
@@ -227,15 +268,12 @@ def flash_attention_stats(
             n_heads=h,
             scale=scale,
             s_stride=s_stride,
+            quant_kv=quant_kv,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b * h, n_t, n_s),
-            in_specs=[
-                pl.BlockSpec((1, block_t, hd), q_map),
-                pl.BlockSpec((1, 1, block_s, hd), kv_map),
-                pl.BlockSpec((1, 1, block_s, hd), kv_map),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, block_t, hd), q_map),
                 pl.BlockSpec((1, block_t, 128), q_map),
@@ -253,7 +291,7 @@ def flash_attention_stats(
             jax.ShapeDtypeStruct((b * h, t, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(pos_arr, spos_arr, qt, k, v)
+    )(pos_arr, spos_arr, *operands)
 
     # [B*H, T, ...] -> [B, KH, G, T, ...]
     acc = acc.reshape(b, kh, g, t, hd)

@@ -1,0 +1,69 @@
+"""Quantized KV-cache container and helpers (shared by models and ops).
+
+Lives in ops/ (not models/) so the Pallas attention kernels can consume a
+QuantKV natively without a models<->ops import cycle: the int8-KV flash
+prefill (VERDICT r4 #3) passes the int8 values and per-row scales straight
+into the kernel instead of materializing a dense bf16 view of the cache.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class QuantKV(NamedTuple):
+    """int8 KV cache tensor: per-row (position) symmetric quantization.
+
+    ``q`` int8 [..., S, hd]; ``s`` f32 [..., S, 1] per-row scales. The
+    trailing singleton keeps the scale tensor the same RANK as the
+    values, so every positional write strategy (plain / cyclic-sp /
+    owning-shard window) and every PartitionSpec applies to both leaves
+    unchanged. The flash prefill kernels consume the pair natively (the
+    scale rides as a second [bs, 1]-blocked ref sharing the kv index
+    map; dequant happens on the VMEM tile after the DMA — so prefill
+    reads int8 bytes, not a materialized dense copy); the windowed
+    decode read dequants in XLA, fused into the attention dot. Halves
+    KV HBM vs bf16 (+1/(2*hd) scale overhead): the long-context fit
+    lever on top of the windowed reads."""
+
+    q: jnp.ndarray
+    s: jnp.ndarray
+
+    @property
+    def shape(self):  # value-tensor shape: callers index S via shape[i]
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+def quantize_kv_rows(val: jnp.ndarray):
+    """[..., T, hd] -> (int8 values, f32 [..., T, 1] scales): the shared
+    grouped symmetric quantizer (ops/int8_matmul.quantize_acts — the Q80
+    move) with one group per cache row, so the KV path and the int8
+    matmul path cannot drift."""
+    from .int8_matmul import quantize_acts
+
+    return quantize_acts(val.astype(jnp.float32), val.shape[-1])
+
+
+def dequant_kv(cache_l, dtype):
+    """Dense view of a cache leaf: QuantKV -> values * scales (XLA
+    fuses this into the consuming attention dot on the decode path);
+    plain arrays pass through."""
+    if isinstance(cache_l, QuantKV):
+        return (cache_l.q.astype(jnp.float32) * cache_l.s).astype(dtype)
+    return cache_l
+
+
+def slice_kv(cache_l, w: int):
+    """Sequence-axis prefix slice of a cache leaf ([B, KH, S, hd] layout),
+    QuantKV-aware; w == 0 means the full view."""
+    if not w:
+        return cache_l
+    if isinstance(cache_l, QuantKV):
+        return QuantKV(cache_l.q[:, :, :w], cache_l.s[:, :, :w])
+    return cache_l[:, :, :w]

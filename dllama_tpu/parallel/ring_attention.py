@@ -47,7 +47,9 @@ def _local_attention_stats(
     requested (TPU hot path — blockwise, no [Tq, Ss] score buffer), else the
     shared jnp math (ops/jnp_ops.attention_stats). Both backends support
     `s_stride` > 1 (cyclic sequence layouts: key row j at position
-    s_pos0 + j*stride)."""
+    s_pos0 + j*stride) and an int8 `QuantKV` shard — the kernel consumes
+    it natively (per-row scales dequant on the VMEM tile; int8-sized HBM
+    reads AND int8-sized ring ppermute payloads), the jnp path dequants."""
     if use_flash:
         from ..ops.flash_attention import flash_attention_stats
 
@@ -55,7 +57,12 @@ def _local_attention_stats(
             q, k, v, q_pos0, s_pos0, interpret=interpret,
             s_stride=s_stride,
         )
-    return _stats_jnp(q, k, v, q_pos0, s_pos0, s_stride=s_stride)
+    from ..ops.kv_cache import dequant_kv
+
+    return _stats_jnp(
+        q, dequant_kv(k, q.dtype), dequant_kv(v, q.dtype), q_pos0, s_pos0,
+        s_stride=s_stride,
+    )
 
 
 def _merge_stats(acc1, m1, l1, acc2, m2, l2):

@@ -131,6 +131,41 @@ def sec_flash() -> None:
             f"{err:.2e} {'OK' if err < 2e-2 else 'FAIL'}",
         )
 
+    # QuantKV-native flash prefill (r5): the [bs, 1]-blocked scale refs
+    # are the one Mosaic-legality unknown (a size-1 TRAILING array dim,
+    # unlike the rejected size-1 block of a larger dim) — this is the
+    # first real-silicon compile+numerics check of that layout, incl.
+    # the strided (cyclic-sp) mode
+    from dllama_tpu.ops.kv_cache import QuantKV, dequant_kv, quantize_kv_rows
+
+    qk = QuantKV(*quantize_kv_rows(kc))
+    qv = QuantKV(*quantize_kv_rows(vc))
+    fo = flash_attention(q, qk, qv, jnp.int32(512))
+    fr = attention_ref(
+        q, dequant_kv(qk, q.dtype), dequant_kv(qv, q.dtype), jnp.int32(512)
+    )
+    err = float(jnp.abs(fo.astype(jnp.float32) - fr.astype(jnp.float32)).max())
+    record(
+        "flash QuantKV prefill abs err", f"{err:.2e} {'OK' if err < 2e-2 else 'FAIL'}"
+    )
+    from dllama_tpu.ops.flash_attention import flash_attention_stats
+
+    acc, m, l = flash_attention_stats(
+        q, qk, qv, jnp.int32(512), jnp.int32(3), s_stride=4
+    )
+    acc_r, m_r, l_r = jnp_stats(
+        q, dequant_kv(qk, q.dtype), dequant_kv(qv, q.dtype),
+        jnp.int32(512), jnp.int32(3), s_stride=4,
+    )
+    lmask = np.asarray(l_r) > 0
+    o = np.asarray(acc) / np.maximum(np.asarray(l)[..., None], 1e-30)
+    o_r = np.asarray(acc_r) / np.maximum(np.asarray(l_r)[..., None], 1e-30)
+    err = float(np.abs(o[lmask] - o_r[lmask]).max()) if lmask.any() else 0.0
+    record(
+        "flash QuantKV strided stats err",
+        f"{err:.2e} {'OK' if err < 2e-2 else 'FAIL'}",
+    )
+
     # NOTE: the round-3 silicon probe (scripts/decode_probe.py) showed
     # Mosaic does NOT elide repeated-index DMAs, so flash decode reads the
     # whole cache regardless of pos and the ENGINE now decodes via

@@ -544,3 +544,154 @@ def test_moe_two_tier_dedup_matches_ragged():
         np.testing.assert_allclose(
             np.asarray(two), np.asarray(base), rtol=2e-2, atol=2e-2
         )
+
+
+def _quant_kv_pair(k, v):
+    from dllama_tpu.ops.kv_cache import QuantKV, quantize_kv_rows
+
+    kq, ks = quantize_kv_rows(k)
+    vq, vs = quantize_kv_rows(v)
+    return QuantKV(kq, ks), QuantKV(vq, vs)
+
+
+def test_flash_stats_quantkv_matches_dequant():
+    """QuantKV-native flash stats (int8 planes + [bs, 1] scale refs,
+    per-tile dequant in the kernel — VERDICT r4 #3) == jnp stats over the
+    dense dequantized view, across offsets, per-lane positions and a
+    parked lane."""
+    from dllama_tpu.ops.flash_attention import flash_attention_stats
+    from dllama_tpu.ops.jnp_ops import attention_stats
+    from dllama_tpu.ops.kv_cache import dequant_kv
+
+    q, k, v = make_qkv(1, 16, 4, 2, 16, 32, seed=31)
+    qk, qv = _quant_kv_pair(k, v)
+    kd, vd = dequant_kv(qk, q.dtype), dequant_kv(qv, q.dtype)
+    for qp, sp in [(0, 0), (16, 0), (40, 16)]:
+        acc, m, l = flash_attention_stats(
+            q, qk, qv, jnp.int32(qp), jnp.int32(sp),
+            block_t=8, block_s=8, interpret=True,
+        )
+        acc_r, m_r, l_r = attention_stats(q, kd, vd, jnp.int32(qp), jnp.int32(sp))
+        mask = np.asarray(l_r) > 0
+        o = np.asarray(acc) / np.maximum(np.asarray(l)[..., None], 1e-30)
+        o_r = np.asarray(acc_r) / np.maximum(np.asarray(l_r)[..., None], 1e-30)
+        np.testing.assert_allclose(o[mask], o_r[mask], rtol=1e-5, atol=1e-5)
+
+    # per-lane positions + parked lane over QuantKV
+    q3, k3, v3 = make_qkv(3, 8, 4, 2, 16, 32, seed=32)
+    qk3, qv3 = _quant_kv_pair(k3, v3)
+    posv = jnp.asarray([0, 16, -64], jnp.int32)
+    acc, m, l = flash_attention_stats(
+        q3, qk3, qv3, posv, jnp.int32(0), block_t=8, block_s=8, interpret=True
+    )
+    kd3, vd3 = dequant_kv(qk3, q3.dtype), dequant_kv(qv3, q3.dtype)
+    for lane, p in enumerate([0, 16]):
+        acc_r, m_r, l_r = attention_stats(
+            q3[lane : lane + 1], kd3[lane : lane + 1], vd3[lane : lane + 1],
+            jnp.int32(p), jnp.int32(0),
+        )
+        mask = np.asarray(l_r[0]) > 0
+        o = np.asarray(acc[lane]) / np.maximum(np.asarray(l[lane])[..., None], 1e-30)
+        o_r = np.asarray(acc_r[0]) / np.maximum(np.asarray(l_r[0])[..., None], 1e-30)
+        np.testing.assert_allclose(o[mask], o_r[mask], rtol=1e-5, atol=1e-5)
+    assert float(np.abs(np.asarray(l[2])).max()) == 0.0
+
+
+def test_flash_stats_quantkv_strided():
+    """QuantKV + s_stride > 1 (cyclic sp shards): the int8-native kernel
+    must keep the strided masks/clamp semantics."""
+    from dllama_tpu.ops.flash_attention import flash_attention_stats
+    from dllama_tpu.ops.jnp_ops import attention_stats
+    from dllama_tpu.ops.kv_cache import dequant_kv
+
+    q, k, v = make_qkv(1, 16, 4, 2, 16, 32, seed=33)
+    qk, qv = _quant_kv_pair(k, v)
+    kd, vd = dequant_kv(qk, q.dtype), dequant_kv(qv, q.dtype)
+    for stride, s0, qpos in [(2, 0, 8), (2, 1, 8), (4, 3, 0), (2, 0, 50)]:
+        acc, m, l = flash_attention_stats(
+            q, qk, qv, jnp.int32(qpos), jnp.int32(s0),
+            block_t=8, block_s=8, interpret=True, s_stride=stride,
+        )
+        acc_r, m_r, l_r = attention_stats(
+            q, kd, vd, jnp.int32(qpos), jnp.int32(s0), s_stride=stride
+        )
+        mask = np.asarray(l_r) > 0
+        assert (np.asarray(l) > 0).tolist() == mask.tolist(), (stride, s0)
+        if mask.any():
+            o = np.asarray(acc) / np.maximum(np.asarray(l)[..., None], 1e-30)
+            o_r = np.asarray(acc_r) / np.maximum(np.asarray(l_r)[..., None], 1e-30)
+            np.testing.assert_allclose(
+                o[mask], o_r[mask], rtol=1e-5, atol=1e-5,
+                err_msg=f"stride={stride} s0={s0} qpos={qpos}",
+            )
+
+
+def test_flash_quantkv_no_dense_materialization():
+    """The int8 prefill read claim (VERDICT r4 #3 'reads ~half of bf16'):
+    (a) the traced program feeds the kernel the int8 planes directly —
+    no dense cache-shaped f32/bf16 intermediate exists anywhere in the
+    jaxpr; (b) the cache-sized kernel inputs are ~53% the bytes of the
+    bf16 dense view (int8 values + f32 per-row scale vs 2B/elem)."""
+    from dllama_tpu.ops.flash_attention import flash_attention_stats
+    from dllama_tpu.ops.kv_cache import QuantKV
+
+    b, kh, s, hd = 1, 2, 256, 64
+    q, k, v = make_qkv(b, 8, 4, kh, hd, s, seed=34)
+    qk, qv = _quant_kv_pair(k.astype(jnp.bfloat16), v.astype(jnp.bfloat16))
+
+    def run(qq, kq, ks, vq, vs):
+        return flash_attention_stats(
+            qq.astype(jnp.bfloat16), QuantKV(kq, ks), QuantKV(vq, vs),
+            jnp.int32(0), jnp.int32(0), block_t=8, block_s=128,
+        )
+
+    txt = str(jax.make_jaxpr(run)(q, qk.q, qk.s, qv.q, qv.s))
+    dense_shape = f"[{b},{kh},{s},{hd}]"
+    assert f"i8{dense_shape}" in txt  # int8 planes reach the kernel
+    for dt in ("f32", "bf16"):
+        assert dt + dense_shape not in txt, (
+            f"dense {dt} cache materialized:\n"
+            + "\n".join(ln for ln in txt.splitlines() if dense_shape in ln)
+        )
+    int8_bytes = qk.q.nbytes + qk.s.nbytes
+    bf16_bytes = 2 * b * kh * s * hd
+    assert int8_bytes / bf16_bytes < 0.55, int8_bytes / bf16_bytes
+
+
+def test_ring_cyclic_flash_quantkv():
+    """ring_attention_local in cyclic mode over a QuantKV shard: flash
+    local step (int8-native) == jnp local step (local dequant); the ring
+    rotates int8 payloads either way."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from dllama_tpu.ops.kv_cache import QuantKV
+    from dllama_tpu.parallel.ring_attention import ring_attention_local
+
+    b, t, h, kh, hd, sp = 1, 32, 4, 2, 16, 4
+    q, k, v = make_qkv(b, t, h, kh, hd, t, seed=35)
+    qk, qv = _quant_kv_pair(k, v)
+    mesh = make_mesh(sp=sp)
+    shard = t // sp
+
+    def run(use_flash):
+        def body(qq, kk, ks, vv, vs):
+            idx = jax.lax.axis_index("sp")
+            return ring_attention_local(
+                qq, QuantKV(kk, ks), QuantKV(vv, vs),
+                q_pos0=idx * (t // sp),
+                shard_size=jnp.int32(shard), axis_name="sp",
+                use_flash=use_flash, interpret=True, cyclic=True,
+            )
+
+        kv_spec = P(None, None, "sp", None)
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, "sp", None, None), kv_spec, kv_spec,
+                      kv_spec, kv_spec),
+            out_specs=P(None, "sp", None, None),
+            check_vma=False,
+        )(q, qk.q, qk.s, qv.q, qv.s)
+
+    np.testing.assert_allclose(
+        np.asarray(run(True)), np.asarray(run(False)), rtol=1e-5, atol=1e-5
+    )
