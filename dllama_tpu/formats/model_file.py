@@ -338,6 +338,38 @@ class ModelReader:
             raise ValueError(f"unsupported float type: {s.float_type}")
         return out.reshape(s.shape)
 
+    def planar_q40_range(
+        self, name: str, o0: int, o1: int, b0: int = 0, b1: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Planar unpack of a rectangular Q40 sub-range: file rows
+        [o0, o1) (the out axis) x 32-element blocks [b0, b1) of each row.
+
+        Copies only the covered bytes out of the memmap — the unit of the
+        STREAMING loader (models/loader), which pulls exactly one device
+        shard's bytes at a time instead of materializing whole layer
+        stacks on host (the TPU-native analogue of the reference's
+        slice-by-slice socket streaming, src/llm.cpp:614-669). 2-D
+        tensors only. Returns (q int8 [o1-o0, (b1-b0)*32],
+        d f16 [o1-o0, b1-b0])."""
+        from .quants import Q40_BLOCK_BYTES
+
+        s = self.by_name[name]
+        if s.float_type != FloatType.Q40 or len(s.shape) != 2:
+            raise ValueError(f"{name}: ranged read needs a 2-D Q40 tensor")
+        out, inner = s.shape
+        nb = inner // 32
+        if b1 is None:
+            b1 = nb
+        if not (0 <= o0 <= o1 <= out and 0 <= b0 <= b1 <= nb):
+            raise ValueError(
+                f"{name}: range rows [{o0},{o1}) blocks [{b0},{b1}) "
+                f"outside ({out}, {nb})"
+            )
+        raw = self.raw(name).reshape(out, nb, Q40_BLOCK_BYTES)
+        sub = np.ascontiguousarray(raw[o0:o1, b0:b1])
+        q, d = q40_to_planar(sub.reshape(-1), (o1 - o0) * (b1 - b0) * 32)
+        return q.reshape(o1 - o0, (b1 - b0) * 32), d.reshape(o1 - o0, b1 - b0)
+
     def planar_q40(self, name: str) -> tuple[np.ndarray, np.ndarray]:
         """Tensor as planar int8 values [out, in] + f16 scales [out, in//32].
 

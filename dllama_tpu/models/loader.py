@@ -14,8 +14,10 @@ reference we consume the file as-is and use interleaved RoPE for llama.
 
 from __future__ import annotations
 
+import os
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -63,6 +65,149 @@ def _interleave_concat(arrs: list[np.ndarray], tp: int) -> np.ndarray:
     return np.concatenate(chunks, axis=-1)
 
 
+def _lead_indices(lead_sls, lead_shape):
+    """Cartesian product of the lead-axis slice ranges (layer / expert)."""
+    import itertools
+
+    ranges = [
+        range(*sl.indices(n)) for sl, n in zip(lead_sls, lead_shape)
+    ]
+    return list(itertools.product(*ranges)) if ranges else [()]
+
+
+def _stream_quant_stack(
+    reader: ModelReader,
+    put: PutFn,
+    tag: str,
+    name_fns: list,
+    lead_shape: tuple[int, ...],
+    fuse: int = 1,
+):
+    """Stacked QuantWeight built WITHOUT materializing the host stack.
+
+    Iterates the sharding's device->index map and answers each shard
+    with ranged reads off the memmap (native C++ unpack;
+    ModelReader.planar_q40_range as the pure-numpy fallback), one unpack
+    per DISTINCT shard index (replicas reuse it), so the host high-water
+    mark is one shard plus one row-range — not the full [L(, E), in, out] stack
+    (at Llama-70B the w13 stack alone is ~37 GB of host RAM; the
+    reference streams node slices over sockets for the same reason,
+    src/llm.cpp:614-669).
+
+    `name_fns`: one per-(lead idx) tensor-name fn, or several for a
+    FUSED weight — constituents interleave shard-major in `fuse` chunks
+    (the _interleave_concat layout restated as index math, so a fused
+    shard never touches the other shards' bytes).
+
+    Returns (QuantWeight, out_dims) with out_dims the constituents'
+    global out dims (FusedQuantWeight metadata)."""
+    from ..formats.quants import Q40_BLOCK_BYTES
+
+    sh = getattr(put, "sharding")(tag)
+    zero = tuple(0 for _ in lead_shape)
+    specs0 = [reader.by_name[fn(*zero)] for fn in name_fns]
+    inner = specs0[0].shape[1]
+    douts = [s.shape[0] for s in specs0]
+    for s in specs0:
+        if s.shape[1] != inner:
+            raise ValueError(f"{tag}: fused constituents disagree on in dim")
+    total_out = sum(douts)
+    nb = inner // 32
+    widths = [d // fuse for d in douts]
+    for d in douts:
+        if d % fuse:
+            raise ValueError(f"fused out dim {d} not divisible by tp={fuse}")
+    cw = sum(widths)
+    offs = [0]
+    for w_ in widths[:-1]:
+        offs.append(offs[-1] + w_)
+
+    def fused_parts(g0: int, g1: int):
+        """(constituent j, file rows [c0, c1)) pieces covering the fused
+        out range [g0, g1), in fused order."""
+        g = g0
+        while g < g1:
+            s, r = divmod(g, cw)
+            j = 0
+            while r >= offs[j] + widths[j]:
+                j += 1
+            take = min(g1 - g, offs[j] + widths[j] - r)
+            c0 = s * widths[j] + (r - offs[j])
+            yield j, c0, c0 + take
+            g += take
+
+    def ranged_both(lead_idx, g0, g1, b0, b1):
+        """Device-layout (values [i, o] int8, scales [i//32, o] f32) for
+        one lead index; ONE unpack pass feeds both leaves (native C++
+        when built). Full-width rows slice the memmap zero-copy; block
+        sub-ranges copy exactly the shard's bytes first."""
+        qs, ds = [], []
+        for j, c0, c1 in fused_parts(g0, g1):
+            name = name_fns[j](*lead_idx)
+            sub_inner = (b1 - b0) * 32
+            if b0 == 0 and b1 == nb:
+                rowb = nb * Q40_BLOCK_BYTES
+                raw = reader.raw(name)[c0 * rowb : c1 * rowb]
+            else:
+                full = reader.raw(name).reshape(-1, nb, Q40_BLOCK_BYTES)
+                raw = np.ascontiguousarray(full[c0:c1, b0:b1]).reshape(-1)
+            unpacked = native.q40_unpack_transposed(raw, c1 - c0, sub_inner)
+            if unpacked is None:
+                q, d = reader.planar_q40_range(name, c0, c1, b0, b1)
+                unpacked = (
+                    np.ascontiguousarray(q.T),
+                    np.ascontiguousarray(d.T).astype(np.float32),
+                )
+            qs.append(unpacked[0])
+            ds.append(unpacked[1])
+        if len(qs) == 1:
+            return qs[0], ds[0]
+        return np.concatenate(qs, axis=1), np.concatenate(ds, axis=1)
+
+    q_shape = (*lead_shape, inner, total_out)
+    d_shape = (*lead_shape, nb, total_out)
+    q_map = sh.addressable_devices_indices_map(q_shape)
+    d_map = sh.addressable_devices_indices_map(d_shape)
+    q_parts, d_parts = [], []
+    built: dict = {}  # replicated shards (dp axes) unpack ONCE per index
+    for dev, q_idx in q_map.items():
+        key = tuple(
+            (sl.start, sl.stop, sl.step) for sl in q_idx
+        )
+        if key not in built:
+            *lead_sls, i_sl, o_sl = q_idx
+            i0, i1, _ = i_sl.indices(inner)
+            o0, o1, _ = o_sl.indices(total_out)
+            if i0 % 32 or i1 % 32:
+                raise ValueError(
+                    f"{tag}: shard slice [{i0},{i1}) not 32-aligned"
+                )
+            b0, b1 = i0 // 32, i1 // 32
+            db_sl = d_map[dev][len(lead_sls)]
+            if db_sl.indices(nb)[:2] != (b0, b1):  # leaves must shard alike
+                raise ValueError(f"{tag}: value/scale shard maps disagree")
+            leads = _lead_indices(lead_sls, lead_shape)
+            pairs = [ranged_both(li, o0, o1, b0, b1) for li in leads]
+            lead_lens = [
+                len(range(*sl.indices(n)))
+                for sl, n in zip(lead_sls, lead_shape)
+            ]
+            q_np = np.stack([p[0] for p in pairs])
+            d_np = np.stack([p[1] for p in pairs])
+            built[key] = (
+                q_np.reshape(*lead_lens, *q_np.shape[1:]),
+                d_np.reshape(*lead_lens, *d_np.shape[1:]),
+            )
+        q_np, d_np = built[key]
+        q_parts.append(jax.device_put(q_np, dev))
+        d_parts.append(jax.device_put(d_np, dev))
+    q_arr = jax.make_array_from_single_device_arrays(q_shape, sh, q_parts)
+    d_arr = jax.make_array_from_single_device_arrays(
+        d_shape, getattr(put, "sharding")(tag), d_parts
+    )
+    return QuantWeight(q_arr, d_arr), tuple(douts)
+
+
 def load_params(
     reader: ModelReader,
     dtype=jnp.float32,
@@ -99,6 +244,15 @@ def load_params(
             f"weight_format='q40' needs a Q40 model file, got "
             f"{h.weight_type.name}"
         )
+    # Streamed shard-by-shard placement whenever the put hook exposes its
+    # shardings (shard_params_put does); DLLAMA_STREAM_LOAD=0 forces the
+    # host-stack path (kept for single-device puts and as the oracle the
+    # streamed path is tested against).
+    streaming = (
+        quantize
+        and getattr(put, "sharding", None) is not None
+        and os.environ.get("DLLAMA_STREAM_LOAD", "1") != "0"
+    )
 
     def w(name: str, transpose: bool = True) -> np.ndarray:
         spec = reader.by_name[name]
@@ -136,6 +290,9 @@ def load_params(
 
     def qw(tag: str, fn: Callable[[int], str]):
         """Stacked QuantWeight for a per-layer matmul tensor."""
+        if streaming:
+            w_, _ = _stream_quant_stack(reader, put, tag, [fn], (h.n_layers,))
+            return w_
         qs, ds = [], []
         for l in range(h.n_layers):
             q_arr, d_arr = unpack_q40(fn(l))
@@ -154,6 +311,11 @@ def load_params(
         """Stacked FusedQuantWeight fusing several row-split matmul tensors
         along the out axis, shard-major for `fuse` tp shards; the fuse
         factor and constituent out dims ride as static pytree metadata."""
+        if streaming:
+            w_, dims = _stream_quant_stack(
+                reader, put, tag, names, (h.n_layers,), fuse=fuse
+            )
+            return FusedQuantWeight(w_, fuse, dims)
         qs, ds = [], []
         dims: tuple[int, ...] = ()
         for l in range(h.n_layers):
@@ -201,6 +363,13 @@ def load_params(
             # same [in, out] device layout as the dense matmuls, stacked
             # [L, E, ...].
             def qexperts(tag: str, which: str) -> QuantWeight:
+                if streaming:
+                    w_, _ = _stream_quant_stack(
+                        reader, put, tag,
+                        [lambda l, e, wh=which: f"layers.{l}.experts.{e}.{wh}"],
+                        (h.n_layers, h.n_experts),
+                    )
+                    return w_
                 lqs, lds = [], []
                 for l in range(h.n_layers):
                     unpacked = [
@@ -248,7 +417,9 @@ def load_params(
         )
 
     cos, sin = rope_cache(h)
-    if quantize:
+    if quantize and streaming:
+        wcls, _ = _stream_quant_stack(reader, put, "wcls", [lambda: "wcls"], ())
+    elif quantize:
         q_arr, d_arr = unpack_q40("wcls")
         wcls = QuantWeight(put("wcls", q_arr), put("wcls", d_arr))
     else:

@@ -75,6 +75,93 @@ def make_header(preset: str | dict, max_seq_len: int = 0) -> LlmHeader:
     return h
 
 
+def write_synth_model(
+    path,
+    preset: str | dict = "llama-70b",
+    seed: int = 0,
+    max_seq_len: int = 4096,
+    n_layers: int | None = None,
+    tile_bytes: int = 8 << 20,
+):
+    """Stream a synthetic random Q40 `.m` of ARBITRARY size to disk with
+    O(tile) host memory: every Q40 tensor is a tiling of one pre-packed
+    random row per distinct width, norms are 1.0, f32 tensors tile a
+    random row. Content quality is irrelevant for what this feeds — fit
+    and loader-streaming rehearsals at real checkpoint scale
+    (docs/70b_plan.md); numeric parity oracles use real converter files.
+    Returns the LlmHeader describing the file."""
+    from ..formats.model_file import tensor_plan
+    from ..formats.quants import quantize_q40
+    from ..formats.writer import write_header
+
+    cfg = dict(PRESETS[preset]) if isinstance(preset, str) else dict(preset)
+    if n_layers is not None:
+        cfg["n_layers"] = n_layers
+    h = make_header(cfg, max_seq_len=max_seq_len)
+    params = {
+        "version": 0,
+        "arch_type": int(h.arch),
+        "dim": h.dim,
+        "hidden_dim": h.hidden_dim,
+        "n_layers": h.n_layers,
+        "n_heads": h.n_heads,
+        "n_kv_heads": h.n_kv_heads,
+        "n_experts": h.n_experts,
+        "n_active_experts": h.n_active_experts,
+        "vocab_size": h.vocab_size,
+        "max_seq_len": h.seq_len,
+        "hidden_act": int(h.hidden_act),
+        "rope_theta": int(h.rope_theta),
+        "weights_float_type": int(FloatType.Q40),
+        "head_dim": h.head_dim,
+        "norm_epsilon": 5,  # header quirk: eps rides as an enum (5 = 1e-5)
+    }
+    if h.arch == LlmArch.QWEN3_MOE:
+        params["moe_hidden_dim"] = h.moe_hidden_dim
+    rng = np.random.default_rng(seed)
+    packed_rows: dict[int, bytes] = {}
+
+    def q40_row(inner: int) -> bytes:
+        if inner not in packed_rows:
+            packed_rows[inner] = quantize_q40(
+                (rng.standard_normal(inner) * 0.02).astype(np.float32)
+            ).tobytes()
+        return packed_rows[inner]
+
+    with open(path, "wb") as f:
+        write_header(f, params)
+        for spec in tensor_plan(h):
+            if spec.float_type == FloatType.F32:
+                if "norm" in spec.name:
+                    f.write(np.ones(spec.shape, np.float32).tobytes())
+                    continue
+                inner = spec.shape[-1]
+                n_rows = int(np.prod(spec.shape[:-1], dtype=np.int64))
+                row = (rng.standard_normal(inner) * 0.02).astype(np.float32)
+                buf = row.tobytes()
+                reps = max(1, tile_bytes // len(buf))
+                tile = buf * reps
+                full, rem = divmod(n_rows, reps)
+                for _ in range(full):
+                    f.write(tile)
+                if rem:
+                    f.write(buf * rem)
+            elif spec.float_type == FloatType.Q40:
+                out, inner = spec.shape[-2], spec.shape[-1]
+                out *= int(np.prod(spec.shape[:-2], dtype=np.int64))
+                row = q40_row(inner)
+                reps = max(1, tile_bytes // len(row))
+                tile = row * reps
+                full, rem = divmod(out, reps)
+                for _ in range(full):
+                    f.write(tile)
+                if rem:
+                    f.write(row * rem)
+            else:  # pragma: no cover - synth files are Q40+F32 only
+                raise ValueError(f"unsupported synth type {spec.float_type}")
+    return h
+
+
 def random_params(
     h: LlmHeader,
     dtype=jnp.bfloat16,

@@ -254,8 +254,10 @@ def forward_pp(
             if park_pos:
                 k_c, v_c = k_new, v_new
             else:
-                k_c = jnp.where(valid, k_new, k_c)
-                v_c = jnp.where(valid, v_new, v_c)
+                # tree_map: an int8 cache is a QuantKV (values, scales) pair
+                sel = lambda a, b: jnp.where(valid, a, b)  # noqa: E731
+                k_c = jax.tree.map(sel, k_new, k_c)
+                v_c = jax.tree.map(sel, v_new, v_c)
             x = jnp.where(valid, x_out, x)
             # a chunk finishing the LAST stage exits into the output
             # register (every stage computes the update; only the last

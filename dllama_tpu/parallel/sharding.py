@@ -103,10 +103,16 @@ def shard_params_put(mesh: Mesh, h: LlmHeader):
         specs = pp_param_specs(specs)
     flat_layer_specs = specs["layers"]
 
-    def put(name: str, arr: np.ndarray):
+    def _spec(name: str) -> P:
         spec = specs.get(name) if name in specs else flat_layer_specs.get(name)
-        if spec is None:
-            spec = P()
-        return jax.device_put(arr, NamedSharding(mesh, spec))
+        return spec if spec is not None else P()
 
+    def put(name: str, arr: np.ndarray):
+        return jax.device_put(arr, NamedSharding(mesh, _spec(name)))
+
+    # Streaming hook: the loader asks for a tensor's sharding UP FRONT and
+    # pulls each device shard's bytes lazily (make_array_from_callback)
+    # instead of materializing whole layer stacks on host — see
+    # models/loader._stream_quant_stack.
+    put.sharding = lambda name: NamedSharding(mesh, _spec(name))
     return put

@@ -1,0 +1,59 @@
+"""Subprocess probe for the streamed-loader host-memory bound test.
+
+Loads a Q40 model onto an 8-device mesh and prints one JSON line with the
+process VmHWM and the logical device bytes. Run in a FRESH process per
+measurement (VmHWM is a process-lifetime high-water mark).
+
+usage: python loader_hwm_probe.py <model.m> <tp> <fuse> <stream 0|1>
+"""
+
+import json
+import os
+import resource
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+
+from dllama_tpu.formats.model_file import ModelReader  # noqa: E402
+from dllama_tpu.models import load_params  # noqa: E402
+from dllama_tpu.parallel import make_mesh, shard_params_put  # noqa: E402
+
+
+def main() -> None:
+    path, tp, fuse, stream = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+    os.environ["DLLAMA_STREAM_LOAD"] = stream
+    r = ModelReader(path)
+    mesh = make_mesh(tp=tp)
+    params = load_params(
+        r, weight_format="q40", dtype=jnp.bfloat16,
+        put=shard_params_put(mesh, r.header), fuse=fuse,
+    )
+    jax.block_until_ready(jax.tree.leaves(params))
+    device_bytes = sum(
+        sh.data.nbytes
+        for leaf in jax.tree.leaves(params)
+        for sh in leaf.addressable_shards
+    )
+    print(
+        json.dumps(
+            {
+                "hwm_gb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6,
+                "device_gb": device_bytes / 1e9,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

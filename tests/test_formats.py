@@ -123,3 +123,20 @@ def test_old_tokenizer_format(tmp_path):
     assert rt.bos_id == 2
     assert rt.eos_token_ids == [1]
     assert rt.chat_template is None
+
+
+def test_planar_q40_range_matches_full(tmp_path):
+    """Ranged planar unpack (the streaming loader's numpy fallback unit)
+    == the corresponding slice of the full planar unpack."""
+    path = str(tmp_path / "r.m")
+    make_tiny_model(path, weight_type=FloatType.Q40)
+    r = ModelReader(path)
+    name = "layers.0.w2"  # (out=64, in=160): 5 blocks/row
+    qf, df = r.planar_q40(name)
+    for o0, o1, b0, b1 in [(0, 64, 0, 5), (8, 40, 0, 5), (0, 64, 1, 4),
+                           (16, 24, 2, 3)]:
+        q, d = r.planar_q40_range(name, o0, o1, b0, b1)
+        np.testing.assert_array_equal(q, qf[o0:o1, b0 * 32 : b1 * 32])
+        np.testing.assert_array_equal(d, df[o0:o1, b0:b1])
+    with pytest.raises(ValueError):
+        r.planar_q40_range(name, 0, 65, 0, 5)

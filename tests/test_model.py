@@ -1,6 +1,8 @@
 """Model forward-pass tests: JAX model vs independent numpy oracle, plus
 prefill/decode consistency invariants."""
 
+from pathlib import Path
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -224,3 +226,97 @@ def test_fused_load_indivisible_tp_fails_loudly(tmp_path):
     r = ModelReader(path)
     with pytest.raises(ValueError, match="not divisible"):
         load_params(r, weight_format="q40", fuse=3)  # kv_dim=32 % 3 != 0
+
+
+def test_streamed_load_matches_stack(tmp_path):
+    """The streaming loader (shard-by-shard make_array_from_callback over
+    ranged memmap reads) must produce leaf-identical params to the
+    host-stack path, for plain, FUSED and MoE-expert Q40 stacks."""
+    import os
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from dllama_tpu.formats.model_file import LlmArch
+    from dllama_tpu.parallel import make_mesh, shard_params_put
+
+    def load(path, arch, mesh, fuse):
+        r = ModelReader(path)
+        return load_params(
+            r, weight_format="q40", put=shard_params_put(mesh, r.header),
+            fuse=fuse,
+        )
+
+    # q40-over-tp=2 needs every contraction dim divisible by 32*tp
+    dense_cfg = dict(dim=64, hidden_dim=128, n_layers=3, n_heads=4,
+                     n_kv_heads=2, head_dim=16, vocab_size=256, seq_len=64)
+    moe_cfg = dict(dim=64, hidden_dim=128, moe_hidden_dim=64, n_layers=2,
+                   n_heads=4, n_kv_heads=2, head_dim=16, vocab_size=256,
+                   seq_len=64, n_experts=4, n_active_experts=2)
+    cases = [
+        ("plain.m", LlmArch.LLAMA, dense_cfg, 0, make_mesh(tp=2, dp=2)),
+        ("fused.m", LlmArch.LLAMA, dense_cfg, 2, make_mesh(tp=2, dp=2)),
+        ("moe.m", LlmArch.QWEN3_MOE, moe_cfg, 0, make_mesh(tp=2, dp=2)),
+        # pp: the one mesh where the lead (layer) axis slicing is
+        # non-trivial — a mis-ordered stage range would pass tp/dp-only
+        ("pp.m", LlmArch.LLAMA, dict(dense_cfg, n_layers=4), 2,
+         make_mesh(tp=2, pp=2)),
+    ]
+    for fname, arch, cfg, fuse, mesh in cases:
+        path = str(tmp_path / fname)
+        make_tiny_model(path, arch=arch, weight_type=FloatType.Q40, cfg=cfg)
+        os.environ["DLLAMA_STREAM_LOAD"] = "0"
+        try:
+            stacked = load(path, arch, mesh, fuse)
+        finally:
+            del os.environ["DLLAMA_STREAM_LOAD"]
+        streamed = load(path, arch, mesh, fuse)
+        ls, lt = jax.tree.leaves(streamed), jax.tree.leaves(stacked)
+        assert len(ls) == len(lt)
+        for a, b in zip(ls, lt):
+            assert a.shape == b.shape and a.dtype == b.dtype
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=fname
+            )
+
+
+@pytest.mark.slow
+def test_streamed_loader_memory_bound(tmp_path):
+    """The 70B fit story's loader half (VERDICT r4 #2): streaming load of
+    a model with REAL Llama-70B layer dims (8192 dim / 28672 ffn; vocab
+    shrunk so embed doesn't dominate a CI run) must keep the host
+    high-water mark near the device bytes — NOT device + whole host
+    layer stacks, which is what the pre-r5 np.stack loader cost (at 80
+    layers the w13 stack alone is ~37 GB). Measured as subprocess VmHWM,
+    streamed vs forced-stack."""
+    import json
+    import subprocess
+    import sys as _sys
+
+    from dllama_tpu.models.synthetic import write_synth_model
+
+    cfg = dict(dim=8192, hidden_dim=28672, n_layers=4, n_heads=64,
+               n_kv_heads=8, head_dim=128, vocab_size=8192, seq_len=2048)
+    path = str(tmp_path / "big.m")
+    write_synth_model(path, cfg, max_seq_len=2048)
+
+    def probe(stream: str) -> dict:
+        out = subprocess.run(
+            [_sys.executable,
+             str(Path(__file__).parent / "loader_hwm_probe.py"),
+             path, "8", "8", stream],
+            capture_output=True, timeout=900, text=True,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    streamed = probe("1")
+    stacked = probe("0")
+    # the stack path holds every [L, in, out] host stack on top of the
+    # device buffers; the streamed path must stay within device bytes +
+    # one-tensor-scale slack (interpreter + jax runtime ~1.5 GB)
+    slack_gb = 1.6
+    assert streamed["hwm_gb"] < streamed["device_gb"] + slack_gb, streamed
+    # and it must beat the stack path by at least the biggest stack
+    # (w13: 4 layers x 8192 x 57344 int8 ~ 1.9 GB)
+    assert stacked["hwm_gb"] - streamed["hwm_gb"] > 1.0, (stacked, streamed)

@@ -546,3 +546,22 @@ def test_forward_pp_x_sp_windowed_decode(tmp_path):
             params, h, step, jnp.int32(len(TOKENS)), cache, mesh,
             attn_window=1025,
         )
+
+
+def test_forward_pp_int8_cache_no_park(tmp_path):
+    """forward_pp with a QuantKV (int8) cache and NO park rows: the
+    invalid-tick cache select must tree-map over the (values, scales)
+    pair (r5 regression — found by the 70B rehearsal script)."""
+    h, params = _params(tmp_path)
+    mesh = make_mesh(pp=2)
+    tokens = jnp.asarray([TOKENS], jnp.int32)
+    lg_ref, _ = forward(
+        params, h, tokens, jnp.int32(0), init_kv_cache(h, 1, dtype=jnp.int8)
+    )
+    lg_pp, cache_pp = forward_pp(
+        params, h, tokens, jnp.int32(0),
+        init_kv_cache(h, 1, dtype=jnp.int8), mesh,
+    )
+    np.testing.assert_allclose(
+        np.asarray(lg_pp), np.asarray(lg_ref), rtol=1e-5, atol=1e-5
+    )
