@@ -79,11 +79,14 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                    help="q40 keeps weights block-quantized on device (Pallas kernel)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR")
-    p.add_argument("--moe-decode-dedup", action="store_true",
+    p.add_argument("--moe-decode-dedup", default="auto", nargs="?",
+                   const="on",  # bare flag keeps its r4 meaning (force on)
+                   choices=["auto", "on", "off"],
                    help="two-tier MoE decode: lax.cond into a small-grid "
                         "grouped kernel when concurrent lanes share most "
-                        "experts (docs/moe_decode_dedup.md); off by "
-                        "default pending real-checkpoint routing data")
+                        "experts (docs/moe_decode_dedup.md); auto = on at "
+                        ">= 8 decode lanes (routing-correlation study, "
+                        "scripts/moe_routing_sim.py)")
     p.add_argument("--sync-measure", default="auto", choices=["auto", "off"],
                    help="measure per-step collective time via a short "
                    "profiled re-run (multi-device greedy runs only; 'off' "
@@ -165,7 +168,9 @@ def load_engine(args):
         weight_format=args.weight_format,
         batch_size=getattr(args, "batch_size", 1),
         buffer_float_type=buffer_ft,
-        moe_decode_dedup=getattr(args, "moe_decode_dedup", False),
+        moe_decode_dedup={"on": True, "off": False}.get(
+            getattr(args, "moe_decode_dedup", "auto"), "auto"
+        ),
     )
     h = engine.header
     print(f"💡 Arch: {h.arch.name}")

@@ -168,42 +168,49 @@ def _stream_quant_stack(
     d_shape = (*lead_shape, nb, total_out)
     q_map = sh.addressable_devices_indices_map(q_shape)
     d_map = sh.addressable_devices_indices_map(d_shape)
-    q_parts, d_parts = [], []
-    built: dict = {}  # replicated shards (dp axes) unpack ONCE per index
+    # group devices by DISTINCT shard index (dp replicas share one
+    # unpack), then build -> device_put -> FREE one shard at a time: the
+    # host never holds more than one shard's numpy buffers (holding all
+    # of them was a ~2x-largest-tensor transient, enough to OOM the
+    # 125 GB rehearsal host at 70B scale)
+    by_key: dict = {}
     for dev, q_idx in q_map.items():
-        key = tuple(
-            (sl.start, sl.stop, sl.step) for sl in q_idx
+        key = tuple((sl.start, sl.stop, sl.step) for sl in q_idx)
+        by_key.setdefault(key, (q_idx, []))[1].append(dev)
+    q_parts: dict = {}
+    d_parts: dict = {}
+    for key, (q_idx, devs) in by_key.items():
+        *lead_sls, i_sl, o_sl = q_idx
+        i0, i1, _ = i_sl.indices(inner)
+        o0, o1, _ = o_sl.indices(total_out)
+        if i0 % 32 or i1 % 32:
+            raise ValueError(f"{tag}: shard slice [{i0},{i1}) not 32-aligned")
+        b0, b1 = i0 // 32, i1 // 32
+        db_sl = d_map[devs[0]][len(lead_sls)]
+        if db_sl.indices(nb)[:2] != (b0, b1):  # leaves must shard alike
+            raise ValueError(f"{tag}: value/scale shard maps disagree")
+        leads = _lead_indices(lead_sls, lead_shape)
+        pairs = [ranged_both(li, o0, o1, b0, b1) for li in leads]
+        lead_lens = [
+            len(range(*sl.indices(n))) for sl, n in zip(lead_sls, lead_shape)
+        ]
+        q_np = np.stack([p[0] for p in pairs])
+        d_np = np.stack([p[1] for p in pairs])
+        del pairs
+        q_np = q_np.reshape(*lead_lens, *q_np.shape[1:])
+        d_np = d_np.reshape(*lead_lens, *d_np.shape[1:])
+        for dev in devs:
+            q_parts[dev] = jax.device_put(q_np, dev)
+            d_parts[dev] = jax.device_put(d_np, dev)
+        jax.block_until_ready(  # transfers done before freeing the source
+            [q_parts[d] for d in devs] + [d_parts[d] for d in devs]
         )
-        if key not in built:
-            *lead_sls, i_sl, o_sl = q_idx
-            i0, i1, _ = i_sl.indices(inner)
-            o0, o1, _ = o_sl.indices(total_out)
-            if i0 % 32 or i1 % 32:
-                raise ValueError(
-                    f"{tag}: shard slice [{i0},{i1}) not 32-aligned"
-                )
-            b0, b1 = i0 // 32, i1 // 32
-            db_sl = d_map[dev][len(lead_sls)]
-            if db_sl.indices(nb)[:2] != (b0, b1):  # leaves must shard alike
-                raise ValueError(f"{tag}: value/scale shard maps disagree")
-            leads = _lead_indices(lead_sls, lead_shape)
-            pairs = [ranged_both(li, o0, o1, b0, b1) for li in leads]
-            lead_lens = [
-                len(range(*sl.indices(n)))
-                for sl, n in zip(lead_sls, lead_shape)
-            ]
-            q_np = np.stack([p[0] for p in pairs])
-            d_np = np.stack([p[1] for p in pairs])
-            built[key] = (
-                q_np.reshape(*lead_lens, *q_np.shape[1:]),
-                d_np.reshape(*lead_lens, *d_np.shape[1:]),
-            )
-        q_np, d_np = built[key]
-        q_parts.append(jax.device_put(q_np, dev))
-        d_parts.append(jax.device_put(d_np, dev))
-    q_arr = jax.make_array_from_single_device_arrays(q_shape, sh, q_parts)
+        del q_np, d_np
+    q_arr = jax.make_array_from_single_device_arrays(
+        q_shape, sh, [q_parts[d] for d in q_map]
+    )
     d_arr = jax.make_array_from_single_device_arrays(
-        d_shape, getattr(put, "sharding")(tag), d_parts
+        d_shape, getattr(put, "sharding")(tag), [d_parts[d] for d in q_map]
     )
     return QuantWeight(q_arr, d_arr), tuple(douts)
 

@@ -139,7 +139,7 @@ class InferenceEngine:
         matmul_precision: str | None = None,
         weight_format: str = "auto",
         buffer_float_type: str = "f32",
-        moe_decode_dedup: bool = False,
+        moe_decode_dedup: bool | str = "auto",
     ):
         self.reader = ModelReader(model_path, max_seq_len=max_seq_len)
         self.header: LlmHeader = self.reader.header
@@ -295,6 +295,32 @@ class InferenceEngine:
         self._compiled = {}
         self._base_key = jax.random.PRNGKey(seed)
         self._rng_calls = 0
+        # window pre-compile (VERDICT r4 #7): decode blocks are AOT-
+        # compiled so a background thread can build the NEXT window's
+        # program before a lane crosses the boundary — the crossing then
+        # performs no synchronous compile. _compile_origin records who
+        # built each program (the boundary-stall test pins "prefetch").
+        import os as _os
+        import threading as _threading
+
+        self._aot_blocks = (
+            _os.environ.get("DLLAMA_WINDOW_PRECOMPILE", "1") != "0"
+        )
+        self._compile_lock = _threading.Lock()
+        self._inflight: dict = {}  # key -> threading.Event
+        self._compile_origin: dict = {}
+
+        if moe_decode_dedup == "auto":
+            # decision boundary from the routing-correlation study
+            # (scripts/moe_routing_sim.py, docs/moe_decode_dedup.md): at
+            # >= 8 decode lanes the small grid hits ~always under even
+            # moderate inter-lane correlation (rho 0.5) or mild expert-
+            # popularity skew, and a miss just takes the ragged branch;
+            # under 8 lanes hits need strong correlation, so the second
+            # compiled program isn't worth carrying
+            moe_decode_dedup = bool(self.header.n_experts and batch_size >= 8)
+        self.moe_decode_dedup = bool(moe_decode_dedup)
+        moe_decode_dedup = self.moe_decode_dedup
 
         # unified forward dispatch: every compiled step goes through this,
         # so the pipeline schedule slots under the SAME bucketed prefill /
@@ -457,17 +483,61 @@ class InferenceEngine:
         self._compiled[key] = step
         return step
 
-    def _decode_block_fn(self, n_steps: int, greedy: bool, window: int = 0):
+    def _block_arg_specs(self, n_steps: int):
+        """ShapeDtypeStructs (with shardings) matching a decode_block
+        dispatch exactly — what the AOT pre-compile lowers against."""
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+            )
+
+        tok = jax.ShapeDtypeStruct(
+            (self.batch_size, 1), jnp.int32, sharding=self._token_sharding
+        )
+        # scalars/rng stay UNSHARDED specs: the dispatch passes fresh
+        # uncommitted arrays, and pinning a single device here conflicts
+        # with multi-device meshes at lowering time
+        scalar_i = jax.ShapeDtypeStruct((), jnp.int32)
+        scalar_f = jax.ShapeDtypeStruct((), jnp.float32)
+        key = jax.random.fold_in(self._base_key, 0)
+        rng = jax.ShapeDtypeStruct(key.shape, key.dtype)
+        return (
+            jax.tree.map(sds, self.params),
+            tok,
+            jax.tree.map(sds, self.cache),
+            scalar_i,
+            rng,
+            scalar_f,
+            scalar_f,
+        )
+
+    def _decode_block_fn(
+        self, n_steps: int, greedy: bool, window: int = 0, origin: str = "dispatch"
+    ):
         """Jitted on-device decode of `n_steps` tokens: the sample ->
         feed-back loop runs under `lax.fori_loop`, so the host pays one
         dispatch per block instead of one per token (host->device dispatch
         costs ~10ms/step when the chip sits behind a tunnel; this is the
         lax.fori_loop multi-step plan from SURVEY.md §7 hard parts).
         Sampling (temperature/top-p) runs on device too; temp/topp are
-        traced so changing them does not recompile."""
+        traced so changing them does not recompile.
+
+        With `_aot_blocks` the program is compiled EAGERLY (AOT lower +
+        compile against the live arg specs) and the cache stores the
+        executable — which is what lets `_prefetch_block` build the next
+        attention window's program off-thread before a lane crosses the
+        boundary (no synchronous compile at the crossing)."""
         key = ("block", n_steps, greedy, window)
-        if key in self._compiled:
-            return self._compiled[key]
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
+            ev = self._inflight.get(key) if origin == "dispatch" else None
+        if ev is not None:  # a prefetch thread is building it: wait, reuse
+            ev.wait()
+            with self._compile_lock:
+                if key in self._compiled:
+                    return self._compiled[key]
         precision = self._precision
         fwd = self._fwd
 
@@ -502,8 +572,45 @@ class InferenceEngine:
             )
             return out, cache
 
-        self._compiled[key] = block
+        if self._aot_blocks:
+            block = block.lower(*self._block_arg_specs(n_steps)).compile()
+        with self._compile_lock:
+            self._compiled[key] = block
+            self._compile_origin[key] = origin
         return block
+
+    def _prefetch(self, key, builder) -> None:
+        """Compile the NEXT attention window's program in a daemon thread
+        (VERDICT r4 #7): called when a lane passes ~75% of the current
+        window, so the boundary crossing finds the program in `_compiled`
+        instead of stalling a serving-path dispatch on a synchronous XLA
+        compile. `builder` must call the matching *_fn with
+        origin='prefetch'."""
+        import threading
+
+        with self._compile_lock:
+            if key in self._compiled or key in self._inflight:
+                return
+            ev = threading.Event()
+            self._inflight[key] = ev
+
+        def work():
+            try:
+                builder()
+            finally:
+                with self._compile_lock:
+                    self._inflight.pop(key, None)
+                ev.set()
+
+        threading.Thread(target=work, daemon=True).start()
+
+    def _prefetch_block(self, n_steps: int, greedy: bool, window: int) -> None:
+        self._prefetch(
+            ("block", n_steps, greedy, window),
+            lambda: self._decode_block_fn(
+                n_steps, greedy, window, origin="prefetch"
+            ),
+        )
 
     def decode_block(
         self, token: int | list[int], pos: int, n_steps: int
@@ -529,6 +636,15 @@ class InferenceEngine:
         greedy = self.temperature == 0.0
         window = self._attn_window(pos + n_steps)
         block = self._decode_block_fn(n_steps, greedy, window)
+        if (
+            self._aot_blocks
+            and window < self.header.seq_len
+            and pos + n_steps >= (3 * window) // 4
+        ):
+            # past 75% of this window: build the next window's program in
+            # the background so the crossing performs no synchronous
+            # compile (the window-boundary p99 stall, VERDICT r4 #7)
+            self._prefetch_block(n_steps, greedy, self._attn_window(window + 1))
         # fold in a call counter so successive generations differ (the
         # reference's xorshift state advances across calls the same way)
         self._rng_calls += 1
@@ -725,7 +841,35 @@ class InferenceEngine:
                 self.cache = step(self.params, arr, self.cache, pos_arr)
             p += width
 
-    def _lane_decode_fn(self, n_steps: int, window: int = 0):
+    def _lane_arg_specs(self, n_steps: int):
+        """Arg specs for a decode_lanes dispatch (the AOT pre-compile's
+        lowering input); per-lane vectors stay unsharded like the
+        scalars in _block_arg_specs."""
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+            )
+
+        b = self.batch_size
+        tok = jax.ShapeDtypeStruct(
+            (b, 1), jnp.int32, sharding=self._token_sharding
+        )
+        key = jax.random.fold_in(self._base_key, 0)
+        return (
+            jax.tree.map(sds, self.params),
+            tok,
+            jax.tree.map(sds, self.cache),
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+            jax.ShapeDtypeStruct((b,), jnp.bool_),
+            jax.ShapeDtypeStruct(key.shape, key.dtype),
+            jax.ShapeDtypeStruct((b,), jnp.float32),
+            jax.ShapeDtypeStruct((b,), jnp.float32),
+        )
+
+    def _lane_decode_fn(
+        self, n_steps: int, window: int = 0, origin: str = "dispatch"
+    ):
         """Per-lane block decode: every lane advances from its own
         position; inactive lanes are parked (fed token 0, writing only
         padding rows). Sampling settings are per-lane vectors (temperature
@@ -733,10 +877,19 @@ class InferenceEngine:
         program serves any mix of requests. One host dispatch per block,
         like decode_block. `window` bounds attention reads by the deepest
         live lane (parked writes land beyond seq_len and are causally
-        masked, so the window only limits reads)."""
+        masked, so the window only limits reads). AOT-compiled like
+        _decode_block_fn so the API server's window crossings can be
+        prefetched too (this IS the serving path)."""
         key = ("lane_block", n_steps, window)
-        if key in self._compiled:
-            return self._compiled[key]
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
+            ev = self._inflight.get(key) if origin == "dispatch" else None
+        if ev is not None:
+            ev.wait()
+            with self._compile_lock:
+                if key in self._compiled:
+                    return self._compiled[key]
         precision = self._precision
         fwd = self._fwd
         park = self._park
@@ -781,7 +934,11 @@ class InferenceEngine:
             )
             return out, cache
 
-        self._compiled[key] = block
+        if self._aot_blocks:
+            block = block.lower(*self._lane_arg_specs(n_steps)).compile()
+        with self._compile_lock:
+            self._compiled[key] = block
+            self._compile_origin[key] = origin
         return block
 
     def decode_lanes(
@@ -825,8 +982,20 @@ class InferenceEngine:
         )
         pos_arr = jnp.asarray(pos, jnp.int32)
         act_arr = jnp.asarray(active, jnp.bool_)
-        window = self._attn_window(max(pos[i] for i in live) + n_steps)
+        deepest = max(pos[i] for i in live)
+        window = self._attn_window(deepest + n_steps)
         block = self._lane_decode_fn(n_steps, window)
+        if (
+            self._aot_blocks
+            and window < self.header.seq_len
+            and deepest + n_steps >= (3 * window) // 4
+        ):
+            self._prefetch(
+                ("lane_block", n_steps, self._attn_window(window + 1)),
+                lambda nw=self._attn_window(window + 1): self._lane_decode_fn(
+                    n_steps, nw, origin="prefetch"
+                ),
+            )
         self._rng_calls += 1
         rng = jax.random.fold_in(
             jax.random.fold_in(self._base_key, max(pos)), self._rng_calls

@@ -86,6 +86,12 @@ def main() -> None:
 
     r = ModelReader(args.path)
     h = r.header
+    if h.n_layers != args.layers or h.seq_len != args.seq_len:
+        raise SystemExit(
+            f"existing {args.path} has {h.n_layers} layers / seq "
+            f"{h.seq_len}, but --layers {args.layers} --seq-len "
+            f"{args.seq_len} was requested; delete the file or match the args"
+        )
     mesh = make_mesh(tp=args.tp, pp=args.pp)
     base_hwm = hwm_gb()
     t0 = time.perf_counter()
@@ -125,10 +131,13 @@ def main() -> None:
     print(json.dumps(rec, indent=1), flush=True)
 
     # one pp4xtp2 prefill chunk + one decode step at full 70B shapes
+    # (cache donated: the engine's steps donate too, and the rehearsal
+    # host has no headroom for two live caches + logits)
     step = jax.jit(
         lambda p, t, c, pos: forward_pp(
             p, h, t, pos, c, mesh, logits_mode="last", sync_quant=False
-        )
+        ),
+        donate_argnums=(2,),
     )
     tok8 = jnp.ones((1, 8), jnp.int32)
     t0 = time.perf_counter()

@@ -29,6 +29,7 @@ import subprocess
 import sys
 
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from dllama_tpu.formats import FloatType
@@ -322,3 +323,127 @@ def test_perplexity_close_reference_qwen3_moe(dllama_binary, tmp_path):
     # Q80 activation quantization in the reference's expert matmuls is the
     # only systematic difference; a few percent covers it
     assert abs(ours_ppl - ref_ppl) / ref_ppl < 0.05, (ours_ppl, ref_ppl)
+
+
+# ~100M-param stress (VERDICT r4 #5): realistic depth/width/GQA — drift
+# that 2-layer fixtures can't catch (accumulation depth, RoPE at real
+# dims, 256-token error growth).
+MID_CFG = dict(dim=768, hidden_dim=2560, n_layers=12, n_heads=12,
+               n_kv_heads=4, head_dim=64, vocab_size=4096, seq_len=512)
+
+
+def _mid_prompt(n_words: int = 60) -> str:
+    words = ["hello", "world", "the", "hi", "there"]
+    import random
+
+    rng = random.Random(7)
+    return " ".join(rng.choice(words) for _ in range(n_words))
+
+
+def test_midsize_greedy_stream_256_matches_reference(dllama_binary, tmp_path):
+    """256-token greedy stream on a ~100M-param f32 model vs the reference
+    binary. Token-for-token equality required; a divergence is excused
+    ONLY if our top-2 logit gap at that step is within f32 cross-
+    implementation noise (argmax tie — both orders defensible), and the
+    matched prefix must already be deep enough to have teeth."""
+    from dllama_tpu.models import forward, init_kv_cache, load_params
+    from dllama_tpu.formats.model_file import ModelReader
+
+    mp = str(tmp_path / "mid.m")
+    tp = str(tmp_path / "mid.t")
+    make_tiny_model(mp, weight_type=FloatType.F32, cfg=dict(MID_CFG), seed=41)
+    make_tiny_tokenizer(tp, pad_to=MID_CFG["vocab_size"])
+    prompt = _mid_prompt(12)
+    steps = 280  # ~256 decode tokens after the prompt
+
+    r = subprocess.run(
+        [dllama_binary, "inference", "--model", mp, "--tokenizer", tp,
+         "--prompt", prompt, "--steps", str(steps), "--temperature", "0.0",
+         "--nthreads", "1", "--buffer-float-type", "f32"],
+        capture_output=True, text=True, timeout=1200,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    ref_text = extract_reference_pieces(r.stdout)
+
+    tok = Tokenizer(tp)
+    prompt_tokens = tok.encode(prompt, is_start=True, add_special_tokens=True)
+    reader = ModelReader(mp)
+    h = reader.header
+    params = load_params(reader)  # f32 dense
+    cache = init_kv_cache(h, 1)
+    arr = jnp.asarray([prompt_tokens], jnp.int32)
+    _, cache = forward(params, h, arr, jnp.int32(0), cache)
+    pos = len(prompt_tokens) - 1
+    token = reference_decode_seed(tok, prompt)
+    ids, gaps = [], []
+    while pos < min(h.seq_len, steps):
+        lg, cache = forward(
+            params, h, jnp.asarray([[token]], jnp.int32), jnp.int32(pos),
+            cache,
+        )
+        row = np.asarray(lg)[0, -1].astype(np.float64)
+        top2 = np.partition(row, -2)[-2:]
+        gaps.append(float(top2[1] - top2[0]))
+        token = int(row.argmax())
+        pos += 1
+        ids.append(token)
+
+    ours = reference_render(tok, ids)
+    if ours != ref_text:
+        # locate the first diverging rendered piece -> step index
+        ref_pieces = ref_text
+        k = 0
+        while k < min(len(ours), len(ref_pieces)) and ours[k] == ref_pieces[k]:
+            k += 1
+        # map char offset back to a conservative step index: count pieces
+        # fully matched so far
+        step = 0
+        for i, t in enumerate(ids):
+            if len(reference_render(tok, ids[: i + 1])) > k:
+                step = i
+                break
+        assert gaps[step] < 1e-3, (
+            f"diverged at step {step} with top-2 gap {gaps[step]:.2e} "
+            f"(not a tie)\nref:  {ref_text[:400]!r}\nours: {ours[:400]!r}"
+        )
+        assert step >= 32, (
+            f"diverged too early (step {step}) to count as drift-free"
+        )
+
+
+def test_midsize_q40_perplexity_nll_bound(dllama_binary, tmp_path):
+    """Perplexity on the ~100M model with Q40 weights: the reference runs
+    Q40 x Q80 integer dots, ours dequantizes to f32 — the NLL must agree
+    within the activation-quantization noise bound at depth 12."""
+    mp = str(tmp_path / "midq.m")
+    tp = str(tmp_path / "midq.t")
+    make_tiny_model(mp, weight_type=FloatType.Q40, cfg=dict(MID_CFG), seed=43)
+    make_tiny_tokenizer(tp, pad_to=MID_CFG["vocab_size"])
+    prompt = _mid_prompt(60)
+
+    r = subprocess.run(
+        [dllama_binary, "perplexity", "--model", mp, "--tokenizer", tp,
+         "--prompt", prompt, "--nthreads", "1",
+         "--buffer-float-type", "q80"],
+        capture_output=True, text=True, timeout=1200,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    m = re.search(r"perplexity: ([0-9.]+)", r.stdout)
+    assert m, r.stdout[-500:]
+    ref_nll = float(np.log(float(m.group(1))))  # nats/token
+
+    cli = subprocess.run(
+        [sys.executable, "-m", "dllama_tpu", "perplexity", "--model", mp,
+         "--tokenizer", tp, "--prompt", prompt, "--dtype", "f32",
+         "--tp", "1", "--weight-format", "q40"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO_ROOT,
+    )
+    assert cli.returncode == 0, cli.stderr[-800:]
+    m2 = re.search(r"perplexity: ([0-9.]+)", cli.stdout)
+    assert m2, cli.stdout[-500:]
+    ours_nll = float(np.log(float(m2.group(1))))
+    # per-token NLL delta bound: Q80 activation quantization noise at
+    # depth 12 stays well under 0.02 nats on this fixture
+    assert abs(ours_nll - ref_nll) < 0.02, (ours_nll, ref_nll)
