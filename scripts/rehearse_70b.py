@@ -32,7 +32,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    flags += " --xla_force_host_platform_device_count=8"
+if "collective_timeout" not in flags:
+    # 8 virtual devices time-slice ONE core here: a shard can take
+    # minutes to reach a collective; the default 40 s rendezvous
+    # termination aborts the whole process mid-decode
+    flags += " --xla_cpu_collective_timeout_seconds=1200"
+os.environ["XLA_FLAGS"] = flags.strip()
 
 import jax
 
