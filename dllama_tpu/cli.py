@@ -201,7 +201,7 @@ def load_engine(args):
     from .utils.telemetry import memory_report
 
     memory_report(
-        engine.params, engine.cache, n_devices=tp * dp * sp * pp
+        engine.params, engine.cache, n_devices=tp * dp * sp * pp, tp=tp
     ).print()
     tok.print_header()
     return engine, tok

@@ -214,6 +214,23 @@ def forward_pp(
         x0 = jnp.zeros((bl, tc, d), globals_["embed"].dtype)  # stage register
         done0 = jnp.zeros((bl, t if keep_all else tc, d), x0.dtype)
 
+        def embed_lookup(ids):
+            # vocab-sharded table under tp (param_spec_tree): each shard
+            # gathers its local rows, out-of-range ids contribute zero,
+            # psum assembles the [B, tc, D] rows — same manual move the
+            # flat path gets from GSPMD's partitioned gather
+            emb = globals_["embed"]
+            if tp > 1:
+                vloc = emb.shape[0]
+                loc = ids - lax.axis_index("tp") * vloc
+                ok = jnp.logical_and(loc >= 0, loc < vloc)
+                rows = emb[jnp.clip(loc, 0, vloc - 1)]
+                return lax.psum(
+                    jnp.where(ok[..., None], rows, jnp.zeros_like(rows)),
+                    "tp",
+                )
+            return emb[ids]
+
         def tick_body(tick, carry):
             # stage s processes chunk c = tick - s this tick (when valid);
             # stage 0 injects chunk `tick`'s embedding first. One traced
@@ -226,7 +243,7 @@ def forward_pp(
             )
             x = jnp.where(
                 jnp.logical_and(stage == 0, tick < n_micro),
-                globals_["embed"][inj],
+                embed_lookup(inj),
                 x,
             )
             c = tick - stage

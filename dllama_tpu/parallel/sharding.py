@@ -56,10 +56,13 @@ def param_spec_tree(h: LlmHeader) -> dict[str, Any]:
         layers["q_norm"] = P()
         layers["k_norm"] = P()
     return {
-        # The reference computes the embedding on the root node only and
-        # broadcasts X (SYNC_WITH_ROOT, src/llm.cpp:256); replicated under
-        # SPMD that broadcast is free.
-        "embed": P(),
+        # vocab-sharded (the reference computes the embedding on the root
+        # node only and broadcasts X — SYNC_WITH_ROOT, src/llm.cpp:256 —
+        # i.e. it holds the whole table on one node; here each shard
+        # holds V/tp rows and the lookup masks+psums). Replicating the
+        # table costs 2.1 GB/chip at 70B (docs/70b_plan.md) for no win:
+        # the psum payload is a [B, T, D] activation, noise next to it.
+        "embed": P("tp", None),
         "wcls": P(None, "tp"),
         "final_norm": P(),
         "rope_cos": P(),

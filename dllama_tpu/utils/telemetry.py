@@ -47,6 +47,8 @@ class MemoryReport:
     cache_bytes: int
     n_devices: int
     replicated_bytes: int = 0
+    tp_sharded_bytes: int = 0  # embed: split over tp, replicated elsewhere
+    tp: int = 1
 
     @property
     def total_bytes(self) -> int:
@@ -54,11 +56,18 @@ class MemoryReport:
 
     @property
     def per_device_bytes(self) -> int:
-        # replicated leaves (embed table, norms, rope) live whole on every
-        # chip; only the sharded remainder divides by the mesh size
+        # replicated leaves (norms, rope) live whole on every chip; the
+        # embed table splits over tp ONLY (P("tp", None)) and is
+        # replicated across the remaining mesh axes; everything else
+        # divides by the full mesh size
         n = max(self.n_devices, 1)
-        sharded = self.total_bytes - self.replicated_bytes
-        return self.replicated_bytes + sharded // n
+        tp = max(self.tp, 1)
+        sharded = self.total_bytes - self.replicated_bytes - self.tp_sharded_bytes
+        return (
+            self.replicated_bytes
+            + self.tp_sharded_bytes // tp
+            + sharded // n
+        )
 
     def print(self) -> None:
         print(f"💾 Params: {_fmt_bytes(self.params_bytes)}")
@@ -71,15 +80,17 @@ class MemoryReport:
 
 
 _REPLICATED_KEYS = {
-    "embed", "final_norm", "rope_cos", "rope_sin",
+    # embed left this set in r5: vocab-sharded over tp (param_spec_tree)
+    "final_norm", "rope_cos", "rope_sin",
     "att_norm", "ffn_norm", "q_norm", "k_norm", "moe_gate",
 }
 
 
-def memory_report(params, cache, n_devices: int = 1) -> MemoryReport:
+def memory_report(params, cache, n_devices: int = 1, tp: int = 1) -> MemoryReport:
     """Accounting of the loaded model (reference: printRequiredMemory).
-    Replication follows parallel/sharding.param_spec_tree: the embed table,
-    norms, gates and rope tables are whole on every chip."""
+    Replication follows parallel/sharding.param_spec_tree: norms, gates
+    and rope tables are whole on every chip; the embed table splits over
+    `tp` (vocab-sharded, r5) and is replicated across the other axes."""
     replicated = 0
     for key in _REPLICATED_KEYS:
         for scope in (params, params.get("layers", {})):
@@ -91,6 +102,8 @@ def memory_report(params, cache, n_devices: int = 1) -> MemoryReport:
         cache_bytes=_leaf_bytes(cache),
         n_devices=n_devices,
         replicated_bytes=replicated,
+        tp_sharded_bytes=_leaf_bytes(params.get("embed")),
+        tp=tp,
     )
 
 
@@ -122,6 +135,9 @@ def ici_traffic_per_token(
     if tp > 1:
         ring = 2 * (tp - 1) / tp
         total += h.n_layers * 2 * h.dim * activation_bytes * ring
+        # vocab-sharded embedding (r5): one [dim] psum assembling the
+        # looked-up row — same payload class as a layer psum
+        total += h.dim * activation_bytes * ring
         if include_logits:
             total += h.vocab_size * 4 * (tp - 1) / tp
     if pp > 1:

@@ -123,7 +123,7 @@ def main() -> None:
     rec["per_device_cache_gb_seq4096_int8"] = round(
         max(dev_c.values()) / 1e9, 3
     )
-    rep = memory_report(params, cache, n_devices=8)
+    rep = memory_report(params, cache, n_devices=8, tp=args.tp)
     rec["params_gb_total"] = round(rep.params_bytes / 1e9, 2)
 
     # analytic long-context budget: int8 KV at the true 131072 context
