@@ -33,14 +33,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     flags += " --xla_force_host_platform_device_count=8"
-if "collective_timeout" not in flags:
-    # 8 virtual devices time-slice ONE core here: a shard can take
-    # minutes to reach a collective. NOTE: this flag parses but does NOT
-    # govern the CPU rendezvous's hard 40 s termination (both 80-layer
-    # runs still aborted at the first DECODE all-reduce with "of 40
-    # seconds exceeded") — on a 1-core host the decode step is
-    # unreachable; prefill completes (docs/70b_plan.md).
-    flags += " --xla_cpu_collective_timeout_seconds=1200"
+# NOTE: 8 virtual devices time-slice ONE core here, so a shard can take
+# minutes to reach a collective, and XLA CPU's rendezvous hard-terminates
+# at 40 s. No flag governs that rendezvous
+# (--xla_cpu_collective_timeout_seconds parses but both 80-layer runs
+# still aborted at the first DECODE all-reduce with "of 40 seconds
+# exceeded") — on a 1-core host the decode step is unreachable; prefill
+# completes (docs/70b_plan.md).
 os.environ["XLA_FLAGS"] = flags.strip()
 
 import jax

@@ -290,6 +290,7 @@ def test_streamed_loader_memory_bound(tmp_path):
     layers the w13 stack alone is ~37 GB). Measured as subprocess VmHWM,
     streamed vs forced-stack."""
     import json
+    import os
     import subprocess
     import sys as _sys
 
@@ -314,9 +315,17 @@ def test_streamed_loader_memory_bound(tmp_path):
     stacked = probe("0")
     # the stack path holds every [L, in, out] host stack on top of the
     # device buffers; the streamed path must stay within device bytes +
-    # one-tensor-scale slack (interpreter + jax runtime ~1.5 GB)
+    # the memmapped FILE (clean file-backed pages count in VmHWM once
+    # every byte has been read, though they are evictable under
+    # pressure) + interpreter/runtime slack
+    file_gb = os.path.getsize(path) / 1e9
+    # measured runtime overhead (hwm - device - file) is ~0.3 GB on this
+    # fixture; 1.6 keeps the bound far from flaking while still well
+    # under the ~1.9 GB biggest host stack the streamed path must avoid
     slack_gb = 1.6
-    assert streamed["hwm_gb"] < streamed["device_gb"] + slack_gb, streamed
+    assert streamed["hwm_gb"] < streamed["device_gb"] + file_gb + slack_gb, (
+        streamed, file_gb,
+    )
     # and it must beat the stack path by at least the biggest stack
     # (w13: 4 layers x 8192 x 57344 int8 ~ 1.9 GB)
     assert stacked["hwm_gb"] - streamed["hwm_gb"] > 1.0, (stacked, streamed)
