@@ -138,6 +138,7 @@ class _LaneState:
     decoder: object  # tokenizer StreamDecoder
     temperature: float
     top_p: float
+    seed: int | None = None  # per-lane sampled-stream reproducibility
     # conversation bookkeeping for this lane's NaiveCache push on finish
     delta_messages: list = field(default_factory=list)
     prompt_end: int = 0
@@ -274,12 +275,10 @@ class LaneScheduler:
                 if p.max_tokens > 0
                 else seq_len
             )
-            # `seed` is IGNORED in lane mode: the on-device RNG stream is
-            # shared across lanes, so reseeding mid-flight would perturb
-            # other clients' in-progress sampled generations (and the
-            # seeded request still wouldn't be reproducible — its draws
-            # depend on which other lanes are active). batch_size == 1
-            # keeps full seed semantics.
+            # `seed` is honored PER LANE (r5): decode_lanes derives each
+            # lane's sampling keys from (its seed, its absolute
+            # positions), so a seeded request reproduces regardless of
+            # which other lanes are active or how blocks split.
             engine_touched = True
             engine.prefill_lane(lane, tokens, pos0=pos0)
             if prompt.public_prompt:
@@ -301,6 +300,7 @@ class LaneScheduler:
                 decoder=tok.stream_decoder(),
                 temperature=p.temperature,
                 top_p=p.top_p,
+                seed=p.seed,
                 delta_messages=list(delta_prompt),
                 prompt_end=prompt_end,
             )
@@ -352,8 +352,9 @@ class LaneScheduler:
         pos = [ls.pos if ls else 0 for ls in self.lanes]
         temps = [ls.temperature if ls else 0.0 for ls in self.lanes]
         topps = [ls.top_p if ls else 1.0 for ls in self.lanes]
+        seeds = [ls.seed if ls else None for ls in self.lanes]
         rows = self.engine.decode_lanes(
-            tokens, pos, self.block_size, active, temps, topps
+            tokens, pos, self.block_size, active, temps, topps, seeds=seeds
         )
         if not rows:
             for lane in range(b):
@@ -684,18 +685,9 @@ def make_handler(state: ApiState):
         def _complete_lanes(self, params: InferenceParams) -> None:
             """Concurrent path: submit to the lane scheduler and relay its
             event stream; many handler threads can sit here at once."""
-            # `seed` cannot be honored here (shared on-device RNG stream
-            # across lanes; see the scheduler note) — tell the client
-            # instead of silently returning non-reproducible output
-            warning = None
-            if params.seed is not None:
-                warning = (
-                    "'seed' is ignored under the concurrent lane scheduler "
-                    "(the on-device RNG stream is shared across lanes); "
-                    "run the server with --batch-size 1 for seeded "
-                    "reproducibility"
-                )
-                print(f"⚠️  {warning}", flush=True)
+            # `seed` is honored per lane (r5): the scheduler threads it
+            # to decode_lanes, whose per-lane (seed, position) keys make
+            # the stream reproducible independent of other lanes
             job = state.scheduler.submit(params)
             if params.stream:
                 self._sse_headers()
@@ -723,8 +715,6 @@ def make_handler(state: ApiState):
                             break
                     if not errored:
                         final = _chunk_payload(state, None, True, finish_reason)
-                        if warning:
-                            final["warning"] = warning
                         _sse_write(
                             self.wfile,
                             "data: " + json.dumps(final) + "\r\n\r\n",
@@ -753,8 +743,6 @@ def make_handler(state: ApiState):
                 job.n_prompt_tokens,
                 job.n_completion,
             )
-            if warning:
-                response["warning"] = warning
             self._json(response)
 
         def _sse_headers(self) -> None:
