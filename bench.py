@@ -38,10 +38,12 @@ def weight_bytes_per_token(h, weight_format: str, i8_group: int = 512) -> int:
     """HBM bytes of weights a single decode step must read: every matmul
     weight once (MoE: attention weights + the active experts' share).
     Q40 device layout = int8 values + f32 scale per 32 block = 1.125
-    B/weight; grouped int8 = 1 + 4/G; dense bf16 = 2 B/weight."""
+    B/weight; grouped int8 = 1 + 4/G; packed nibbles + f16 scales =
+    0.5625; dense bf16 = 2 B/weight."""
     bpw = {
         "q40": 1.125,
         "q40i8": 1.0 + 4.0 / i8_group,
+        "q40i4": 0.5 + 2.0 / 32.0,
     }.get(weight_format, 2.0)
     att = h.dim * h.q_dim + 2 * h.dim * h.kv_dim + h.q_dim * h.dim
     ffn = 3 * h.dim * h.ff_dim
@@ -294,7 +296,7 @@ def main() -> None:
     params = random_params(
         h, dtype=jnp.bfloat16, mesh=mesh, weight_format=weight_format,
         # fused qkv/w13 launches, like the engine's q40 default
-        fuse=tp if weight_format in ("q40", "q40i8") else 0,
+        fuse=tp if weight_format in ("q40", "q40i8", "q40i4") else 0,
     )
     cache = init_kv_cache(h, batch_size=1, dtype=kv_dtype)
     cspecs = cache_specs(h)
@@ -417,6 +419,49 @@ def main() -> None:
         log(f"{n_lanes}-lane decode: {lanes_tok_s:.2f} aggregate tok/s/chip "
             f"({lanes_tok_s / per_chip:.2f}x single-stream)")
 
+    # staged weight-format sweep (BENCH_SWEEP_FORMATS=1): after the
+    # headline format, rebuild params in each OTHER quantized device
+    # format and run one timed decode block — a single silicon session
+    # then ranks q40 (int8 unpack) vs q40i8 (MXU integer dots) vs q40i4
+    # (packed nibbles, in-kernel unpack) on identical shapes. Stages run
+    # serially and free the previous format's params first, so HBM holds
+    # one weight copy at a time.
+    sweep_results = {}
+    if os.environ.get("BENCH_SWEEP_FORMATS") and not os.environ.get(
+        "BENCH_CPU_FALLBACK"
+    ):
+        for fmt in ("q40", "q40i8", "q40i4"):
+            if fmt == weight_format:
+                sweep_results[fmt] = round(per_chip, 2)  # headline run
+                continue
+            del params
+            params = random_params(
+                h, dtype=jnp.bfloat16, mesh=mesh, weight_format=fmt,
+                fuse=tp,
+            )
+            cache_f = init_kv_cache(h, batch_size=1, dtype=kv_dtype)
+            cache_f = {
+                k: jax.device_put(v, NamedSharding(mesh, cspecs[k]))
+                for k, v in cache_f.items()
+            }
+            tok_f = jax.device_put(
+                jnp.asarray([[1]], dtype=jnp.int32), token_sharding
+            )
+            tok_f, cache_f = decode_block(
+                params, tok_f, cache_f, steps, jnp.int32(0)
+            )
+            _ = np.asarray(tok_f)  # compile + warmup
+            t0 = time.perf_counter()
+            tok_f, cache_f = decode_block(
+                params, tok_f, cache_f, steps, jnp.int32(steps)
+            )
+            _ = np.asarray(tok_f)
+            sweep_results[fmt] = round(
+                steps / (time.perf_counter() - t0) / tp, 2
+            )
+            log(f"sweep {fmt}: {sweep_results[fmt]} tok/s/chip")
+            del cache_f
+
     if _wall_timer is not None:
         _wall_timer.cancel()  # exactly ONE JSON line on a healthy run
     result = dict(_partial_result)
@@ -424,6 +469,8 @@ def main() -> None:
         result["ttft_ms_p50"] = round(ttft_p50, 1)
     if lanes_tok_s is not None:
         result[f"lanes{n_lanes}_tok_s_per_chip"] = round(lanes_tok_s, 2)
+    if sweep_results:
+        result["format_sweep_tok_s_per_chip"] = sweep_results
     print(json.dumps(result))
 
 

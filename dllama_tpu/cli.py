@@ -60,7 +60,7 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                         "--sp and --batch-size lanes")
     p.add_argument("--dp", type=int, default=1,
                    help="data-parallel chips: batch lanes shard across "
-                        "dp (requires batch-size % dp == 0); the "
+                        "dp (requires batch-size %% dp == 0); the "
                         "throughput axis for pp (docs/pp_decode_model.md)")
     p.add_argument("--workers", nargs="*", default=None, help="alias for --tp: pass a chip count (host:port lists are a LAN-cluster concept)")
     p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
@@ -75,8 +75,11 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--gpu-index", type=int, default=None)
     p.add_argument("--gpu-segments", default=None)
     p.add_argument("--weight-format", default="auto",
-                   choices=["auto", "q40", "q40i8", "dense"],
-                   help="q40 keeps weights block-quantized on device (Pallas kernel)")
+                   choices=["auto", "q40", "q40i8", "q40i4", "dense"],
+                   help="q40 keeps weights block-quantized on device "
+                        "(Pallas kernel); q40i8 requantizes to grouped "
+                        "int8 for MXU integer dots; q40i4 stores packed "
+                        "nibbles (0.56 B/weight, in-kernel unpack)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR")
     p.add_argument("--moe-decode-dedup", default="auto", nargs="?",

@@ -157,6 +157,30 @@ def q40_to_planar(raw: np.ndarray, n_elements: int) -> tuple[np.ndarray, np.ndar
     return q.reshape(-1), d
 
 
+def pack_q40_device(
+    q: np.ndarray, d: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Re-pack device-layout planar Q40 into the packed-nibble device
+    format (weight_format="q40i4"; ops.quant_matmul.PackedQuantWeight).
+
+    ``q`` int8 [..., in, out] values in [-8, 7], ``d`` float [..., in//32,
+    out] scales -> (``qp`` int8 [..., in//2, out], ``d`` f16). The nibble
+    pairing matches the wire format's own intra-block layout (byte j: low
+    nibble element j, high nibble element j + 16), so the in-kernel unpack
+    is the same shift/mask as `q40_to_planar`. Scales go back to f16 — the
+    wire scale dtype, so the cast is exact and the device cost is
+    0.5 + 2/32 = 0.5625 B/weight including scales."""
+    *lead, inner, out = q.shape
+    if inner % Q40_BLOCK_SIZE:
+        raise ValueError(f"in dim {inner} not a multiple of {Q40_BLOCK_SIZE}")
+    half = Q40_BLOCK_SIZE // 2
+    blk = q.reshape(*lead, inner // Q40_BLOCK_SIZE, Q40_BLOCK_SIZE, out)
+    lo = (blk[..., :half, :].astype(np.int16) + 8)
+    hi = (blk[..., half:, :].astype(np.int16) + 8)
+    qp = np.ascontiguousarray((lo | (hi << 4)).astype(np.uint8)).view(np.int8)
+    return qp.reshape(*lead, inner // 2, out), d.astype(np.float16)
+
+
 def q80_to_planar(raw: np.ndarray, n_elements: int) -> tuple[np.ndarray, np.ndarray]:
     """Unpack packed Q80 bytes into planar (values int8, scales f16)."""
     n_blocks = n_elements // Q80_BLOCK_SIZE

@@ -22,10 +22,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..formats.model_file import LlmArch, LlmHeader, ModelReader
-from ..formats.quants import FloatType
+from ..formats.quants import FloatType, pack_q40_device
 from ..ops.jnp_ops import rope_cache
 from ..ops.quant_matmul import (
     FusedQuantWeight,
+    PackedQuantWeight,
     QuantWeight,
     planar_to_device_layout,
 )
@@ -82,6 +83,7 @@ def _stream_quant_stack(
     name_fns: list,
     lead_shape: tuple[int, ...],
     fuse: int = 1,
+    packed: bool = False,
 ):
     """Stacked QuantWeight built WITHOUT materializing the host stack.
 
@@ -99,8 +101,15 @@ def _stream_quant_stack(
     (the _interleave_concat layout restated as index math, so a fused
     shard never touches the other shards' bytes).
 
-    Returns (QuantWeight, out_dims) with out_dims the constituents'
-    global out dims (FusedQuantWeight metadata)."""
+    `packed` re-packs each shard into the nibble device format
+    (weight_format="q40i4": int8 byte = two int4 values, f16 scales)
+    HOST-SIDE before device_put — the device never sees the 1 B/value
+    layout, and the fused qkv/w13 interleave metadata is unchanged
+    because packing acts on the in axis while the interleave permutes
+    the out axis.
+
+    Returns (QuantWeight | PackedQuantWeight, out_dims) with out_dims the
+    constituents' global out dims (FusedQuantWeight metadata)."""
     from ..formats.quants import Q40_BLOCK_BYTES
 
     sh = getattr(put, "sharding")(tag)
@@ -190,13 +199,29 @@ def _stream_quant_stack(
         if db_sl.indices(nb)[:2] != (b0, b1):  # leaves must shard alike
             raise ValueError(f"{tag}: value/scale shard maps disagree")
         leads = _lead_indices(lead_sls, lead_shape)
-        pairs = [ranged_both(li, o0, o1, b0, b1) for li in leads]
         lead_lens = [
             len(range(*sl.indices(n))) for sl, n in zip(lead_sls, lead_shape)
         ]
-        q_np = np.stack([p[0] for p in pairs])
-        d_np = np.stack([p[1] for p in pairs])
-        del pairs
+        # preallocate at the final shard shape and write each lead index's
+        # unpack (and optional nibble re-pack) in place: a pairs list +
+        # np.stack would hold TWO copies of the shard at once — several GB
+        # of transient for a 70B w13 tp shard
+        sub_inner = (b1 - b0) * 32
+        q_np = np.empty(
+            (len(leads), sub_inner // 2 if packed else sub_inner, o1 - o0),
+            np.int8,
+        )
+        d_np = np.empty(
+            (len(leads), b1 - b0, o1 - o0),
+            np.float16 if packed else np.float32,
+        )
+        for i, li in enumerate(leads):
+            q_i, d_i = ranged_both(li, o0, o1, b0, b1)
+            if packed:
+                q_i, d_i = pack_q40_device(q_i, d_i)
+            q_np[i] = q_i
+            d_np[i] = d_i
+            del q_i, d_i
         q_np = q_np.reshape(*lead_lens, *q_np.shape[1:])
         d_np = d_np.reshape(*lead_lens, *d_np.shape[1:])
         for dev in devs:
@@ -206,13 +231,15 @@ def _stream_quant_stack(
             [q_parts[d] for d in devs] + [d_parts[d] for d in devs]
         )
         del q_np, d_np
+    out_q_shape = (*lead_shape, inner // 2, total_out) if packed else q_shape
     q_arr = jax.make_array_from_single_device_arrays(
-        q_shape, sh, [q_parts[d] for d in q_map]
+        out_q_shape, sh, [q_parts[d] for d in q_map]
     )
     d_arr = jax.make_array_from_single_device_arrays(
         d_shape, getattr(put, "sharding")(tag), [d_parts[d] for d in q_map]
     )
-    return QuantWeight(q_arr, d_arr), tuple(douts)
+    cls = PackedQuantWeight if packed else QuantWeight
+    return cls(q_arr, d_arr), tuple(douts)
 
 
 def load_params(
@@ -236,6 +263,13 @@ def load_params(
     file's device footprint stays ~1.125 B/weight instead of blowing up to
     bf16 density.
 
+    `weight_format="q40i4"` additionally re-packs the matmul weights into
+    the nibble device format (`PackedQuantWeight`: two int4 values per
+    byte + f16 scales, 0.5625 B/weight) host-side during the load; the
+    Pallas kernel unpacks in VMEM after the HBM copy. MoE expert weights
+    stay int8 `QuantWeight` (the ragged MoE kernels consume that layout),
+    same policy as q40i8's requantize.
+
     `fuse` (quantized path only): the tp shard count; > 0 emits fused
     "wqkv" (q|k|v) and, for dense-FFN archs, "w13" (w1|w3) weights in
     shard-major interleaved layout instead of the separate tensors —
@@ -245,10 +279,11 @@ def load_params(
     mesh's tp axis size.
     """
     h = reader.header
-    quantize = weight_format == "q40"
+    quantize = weight_format in ("q40", "q40i4")
+    packed = weight_format == "q40i4"
     if quantize and h.weight_type != FloatType.Q40:
         raise ValueError(
-            f"weight_format='q40' needs a Q40 model file, got "
+            f"weight_format={weight_format!r} needs a Q40 model file, got "
             f"{h.weight_type.name}"
         )
     # Streamed shard-by-shard placement whenever the put hook exposes its
@@ -296,16 +331,22 @@ def load_params(
         return unpacked
 
     def qw(tag: str, fn: Callable[[int], str]):
-        """Stacked QuantWeight for a per-layer matmul tensor."""
+        """Stacked QuantWeight (or PackedQuantWeight when packed) for a
+        per-layer matmul tensor."""
         if streaming:
-            w_, _ = _stream_quant_stack(reader, put, tag, [fn], (h.n_layers,))
+            w_, _ = _stream_quant_stack(
+                reader, put, tag, [fn], (h.n_layers,), packed=packed
+            )
             return w_
         qs, ds = [], []
         for l in range(h.n_layers):
             q_arr, d_arr = unpack_q40(fn(l))
+            if packed:
+                q_arr, d_arr = pack_q40_device(q_arr, d_arr)
             qs.append(q_arr)
             ds.append(d_arr)
-        return QuantWeight(put(tag, np.stack(qs)), put(tag, np.stack(ds)))
+        cls = PackedQuantWeight if packed else QuantWeight
+        return cls(put(tag, np.stack(qs)), put(tag, np.stack(ds)))
 
     layers: dict[str, jnp.ndarray] = {}
     layers["att_norm"] = put(
@@ -320,7 +361,8 @@ def load_params(
         factor and constituent out dims ride as static pytree metadata."""
         if streaming:
             w_, dims = _stream_quant_stack(
-                reader, put, tag, names, (h.n_layers,), fuse=fuse
+                reader, put, tag, names, (h.n_layers,), fuse=fuse,
+                packed=packed,
             )
             return FusedQuantWeight(w_, fuse, dims)
         qs, ds = [], []
@@ -328,10 +370,18 @@ def load_params(
         for l in range(h.n_layers):
             parts = [unpack_q40(fn(l)) for fn in names]
             dims = tuple(p[0].shape[-1] for p in parts)
-            qs.append(_interleave_concat([p[0] for p in parts], fuse))
-            ds.append(_interleave_concat([p[1] for p in parts], fuse))
+            # interleave permutes the out axis, packing halves the in
+            # axis — they commute, so the fuse/dims metadata is the same
+            # for both device formats
+            q_l = _interleave_concat([p[0] for p in parts], fuse)
+            d_l = _interleave_concat([p[1] for p in parts], fuse)
+            if packed:
+                q_l, d_l = pack_q40_device(q_l, d_l)
+            qs.append(q_l)
+            ds.append(d_l)
+        cls = PackedQuantWeight if packed else QuantWeight
         return FusedQuantWeight(
-            QuantWeight(put(tag, np.stack(qs)), put(tag, np.stack(ds))),
+            cls(put(tag, np.stack(qs)), put(tag, np.stack(ds))),
             fuse,
             dims,
         )
@@ -368,7 +418,9 @@ def load_params(
             # src/nn/nn-network.cpp:856-888); the ragged MoE kernel
             # dequantizes selected blocks in VMEM. Layout per expert is the
             # same [in, out] device layout as the dense matmuls, stacked
-            # [L, E, ...].
+            # [L, E, ...]. Under weight_format="q40i4" the experts KEEP
+            # this int8 layout (the ragged MoE kernels consume it; same
+            # policy as q40i8's requantize, int8_matmul.requantize_params).
             def qexperts(tag: str, which: str) -> QuantWeight:
                 if streaming:
                     w_, _ = _stream_quant_stack(
@@ -425,10 +477,15 @@ def load_params(
 
     cos, sin = rope_cache(h)
     if quantize and streaming:
-        wcls, _ = _stream_quant_stack(reader, put, "wcls", [lambda: "wcls"], ())
+        wcls, _ = _stream_quant_stack(
+            reader, put, "wcls", [lambda: "wcls"], (), packed=packed
+        )
     elif quantize:
         q_arr, d_arr = unpack_q40("wcls")
-        wcls = QuantWeight(put("wcls", q_arr), put("wcls", d_arr))
+        if packed:
+            q_arr, d_arr = pack_q40_device(q_arr, d_arr)
+        cls = PackedQuantWeight if packed else QuantWeight
+        wcls = cls(put("wcls", q_arr), put("wcls", d_arr))
     else:
         wcls = put("wcls", w("wcls").astype(dtype))
     params: Params = {

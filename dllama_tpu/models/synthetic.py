@@ -212,29 +212,39 @@ def random_params(
         )
         return f(key)
 
-    def mk_quant(name, *shape):
+    def mk_quant(name, *shape, packed=False):
         """Random QuantWeight [..., in, out] on device: int8 values in
-        [-8, 7] + f32 per-block scales (the loader's q40 layout)."""
+        [-8, 7] + f32 per-block scales (the loader's q40 layout). With
+        `packed` the q40i4 device layout instead: nibble-packed int8
+        [..., in//2, out] + f16 scales — any byte is a valid nibble pair,
+        so the packed tensor is generated directly at its final shape."""
         import zlib
 
-        from ..ops.quant_matmul import QuantWeight
+        from ..ops.quant_matmul import PackedQuantWeight, QuantWeight
 
         sh = sharding_for(name)
         *lead, inner, out = shape
         key = jax.random.fold_in(root_key, zlib.crc32(name.encode()))
         kq, kd = jax.random.split(key)
+        q_shape = (*lead, inner // 2, out) if packed else shape
         q = jax.jit(
-            lambda k: jax.random.randint(k, shape, -8, 8, dtype=jnp.int8),
+            lambda k: (
+                jax.random.randint(k, q_shape, -128, 128, dtype=jnp.int8)
+                if packed
+                else jax.random.randint(k, q_shape, -8, 8, dtype=jnp.int8)
+            ),
             out_shardings=sh,
         )(kq)
         d_shape = (*lead, inner // 32, out)
+        d_dtype = jnp.float16 if packed else jnp.float32
         d = jax.jit(
             lambda k: jax.random.uniform(
                 k, d_shape, jnp.float32, minval=0.5 * scale / 8, maxval=scale / 8
-            ),
+            ).astype(d_dtype),
             out_shardings=sh,
         )(kd)
-        return QuantWeight(q, d)
+        cls = PackedQuantWeight if packed else QuantWeight
+        return cls(q, d)
 
     def dev(name, arr):
         sh = sharding_for(name)
@@ -246,8 +256,16 @@ def random_params(
     moe = h.arch == LlmArch.QWEN3_MOE
     E = h.n_experts
 
-    quant = weight_format in ("q40", "q40i8")
-    mm = mk_quant if quant else mk
+    quant = weight_format in ("q40", "q40i8", "q40i4")
+    packed = weight_format == "q40i4"
+    if quant:
+        def mm(name, *shape, expert=False):
+            # MoE experts stay int8 QuantWeight under q40i4 (the ragged
+            # kernels consume that layout; loader policy)
+            return mk_quant(name, *shape, packed=packed and not expert)
+    else:
+        def mm(name, *shape, expert=False):
+            return mk(name, *shape)
     layers = {
         "att_norm": mk("att_norm", L, D, norm=True),
         "ffn_norm": mk("ffn_norm", L, D, norm=True),
@@ -255,9 +273,9 @@ def random_params(
         # MoE experts follow the loader's policy: quantized on device for
         # q40 (the ragged/grouped kernels dequantize selected blocks in
         # VMEM), dense otherwise
-        "w1": mm("w1", L, E, D, FF) if moe else mm("w1", L, D, FF),
-        "w2": mm("w2", L, E, FF, D) if moe else mm("w2", L, FF, D),
-        "w3": mm("w3", L, E, D, FF) if moe else mm("w3", L, D, FF),
+        "w1": mm("w1", L, E, D, FF, expert=True) if moe else mm("w1", L, D, FF),
+        "w2": mm("w2", L, E, FF, D, expert=True) if moe else mm("w2", L, FF, D),
+        "w3": mm("w3", L, E, D, FF, expert=True) if moe else mm("w3", L, D, FF),
     }
     if quant and fuse:
         # fused-launch layout (loader `fuse`): the content is random either

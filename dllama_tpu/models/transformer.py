@@ -34,7 +34,18 @@ from jax import lax
 from ..formats.model_file import HiddenAct, LlmArch, LlmHeader, RopeType
 from ..ops.jnp_ops import apply_rope, gelu, qk_rms_norm, rms_norm, silu
 from ..ops.int8_matmul import Int8Weight, i8matmul_tp
-from ..ops.quant_matmul import QuantWeight, dequant, qmatmul_tp
+from ..ops.quant_matmul import (
+    PackedQuantWeight,
+    QuantWeight,
+    dequant,
+    qmatmul_tp,
+)
+
+# both Q40 device formats ride the same qmatmul dispatch (the packed
+# variant unpacks nibbles in VMEM); MoE expert leaves stay plain
+# QuantWeight under every quantized format, so the expert kernels below
+# test for that class alone
+_QUANT_CLASSES = (QuantWeight, PackedQuantWeight)
 from ..ops.flash_attention import flash_attention, pick_flash_blocks
 # QuantKV lives in ops/kv_cache so the flash kernels consume it natively
 # (no models<->ops cycle); re-exported here for engine/cli/pipeline use.
@@ -76,7 +87,7 @@ def _mm(x: jnp.ndarray, w, role: str, mesh, sync_quant: bool = False) -> jnp.nda
     (reference: --buffer-float-type q80)."""
     if isinstance(w, Int8Weight):
         return i8matmul_tp(x, w, role, mesh, sync_quant=sync_quant).astype(x.dtype)
-    if isinstance(w, QuantWeight):
+    if isinstance(w, _QUANT_CLASSES):
         return qmatmul_tp(x, w, role, mesh, sync_quant=sync_quant).astype(x.dtype)
     return jnp.einsum("bti,io->bto", x, w)
 
@@ -106,7 +117,7 @@ def _mm_manual(
         from ..ops.int8_matmul import i8matmul
 
         return reduce(i8matmul(x, w)).astype(x.dtype)
-    if isinstance(w, QuantWeight):
+    if isinstance(w, _QUANT_CLASSES):
         return reduce(qmatmul(x, w)).astype(x.dtype)
     return reduce(jnp.einsum("bti,io->bto", x, w))
 
@@ -211,7 +222,7 @@ def _attention_tp(
     if mesh is None or mesh.devices.size == 1:
         out = kernel(q, k_cache, v_cache, pos)
     else:
-        from jax import shard_map
+        from ..utils.compat import shard_map_compat as shard_map
         from jax.sharding import PartitionSpec as P
 
         spec_q = P("dp", None, "tp", None)
@@ -301,7 +312,7 @@ def _attention_sp(
 
     Heads stay tp-sharded inside the same shard_map — attention needs no
     tp collectives (reference: sliceMultiHeadAtt head independence)."""
-    from jax import shard_map
+    from ..utils.compat import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.ring_attention import ring_attention_local
@@ -597,7 +608,7 @@ def _moe_ffn_pallas(
     if mesh is None or mesh.devices.size == 1:
         out = run(*operands)
     else:
-        from jax import shard_map
+        from ..utils.compat import shard_map_compat as shard_map
         from jax.sharding import PartitionSpec as P
 
         # tokens ride the dp axis (xf's flat axis folds in the dp-sharded
@@ -678,7 +689,7 @@ def _moe_ffn_grouped(
     if mesh is None or mesh.devices.size == 1:
         out = run(*operands)
     else:
-        from jax import shard_map
+        from ..utils.compat import shard_map_compat as shard_map
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.collectives import psum_maybe_quantized
@@ -808,7 +819,7 @@ def logits_head(
 
         if isinstance(wcls, Int8Weight):
             local = i8matmul(y, wcls)
-        elif isinstance(wcls, QuantWeight):
+        elif isinstance(wcls, _QUANT_CLASSES):
             local = qmatmul(y, wcls)
         else:
             local = jnp.einsum(
@@ -818,7 +829,7 @@ def logits_head(
         return lax.all_gather(local, tp_axis, axis=-1, tiled=True)
     if isinstance(wcls, Int8Weight):
         return i8matmul_tp(y, wcls, "row", mesh)
-    if isinstance(wcls, QuantWeight):
+    if isinstance(wcls, _QUANT_CLASSES):
         return qmatmul_tp(y, wcls, "row", mesh)
     return jnp.einsum(
         "btd,dv->btv", y.astype(jnp.float32), wcls.astype(jnp.float32)

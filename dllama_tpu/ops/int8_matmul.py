@@ -241,7 +241,7 @@ def i8matmul_tp(
     if mesh is None or mesh.devices.size == 1:
         return i8matmul(x, w)
 
-    from jax import shard_map
+    from ..utils.compat import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
     if role == "row":

@@ -56,6 +56,38 @@ class QuantWeight(NamedTuple):
         return self.q.shape[-1]
 
 
+class PackedQuantWeight(NamedTuple):
+    """Packed-nibble Q40 tensor in device layout (weight_format="q40i4").
+
+    Two int4 values per int8 byte in HBM, following the wire format's own
+    intra-block pairing (formats/quants.py): within each 32-element quant
+    block, byte row j holds element j in its low nibble and element j + 16
+    in its high nibble. The kernel unpacks AFTER the HBM->VMEM copy
+    (shift/mask, then the same sublane-broadcast scale multiply as the
+    int8 path), so HBM traffic drops to what is actually stored:
+
+    ``qp`` int8 [..., in // 2, out] packed nibble pairs;
+    ``d``  f16 [..., in // 32, out] per-block scales — f16 IS the wire
+    scale dtype, so packed dequant is bit-identical to the int8 path's
+    (which widens the same f16 values to f32).
+
+    0.5 + 2/32 = 0.5625 B/weight including scales, vs 1.125 for the
+    unpacked QuantWeight layout — decode matmuls are HBM-bandwidth-bound,
+    so this halves the weight-read floor per token.
+    """
+
+    qp: jnp.ndarray
+    d: jnp.ndarray
+
+    @property
+    def in_dim(self) -> int:
+        return self.qp.shape[-2] * 2
+
+    @property
+    def out_dim(self) -> int:
+        return self.qp.shape[-1]
+
+
 @jax.tree_util.register_pytree_node_class
 class FusedQuantWeight:
     """Several row-split matmul weights fused along the out axis in
@@ -106,10 +138,58 @@ def dequant(w: QuantWeight, dtype=jnp.bfloat16) -> jnp.ndarray:
     return dense.reshape(*lead, inner, out).astype(dtype)
 
 
-def qmatmul_ref(x: jnp.ndarray, w: QuantWeight) -> jnp.ndarray:
+def pack_nibbles(w: QuantWeight) -> PackedQuantWeight:
+    """Device-layout int8 QuantWeight -> packed-nibble PackedQuantWeight
+    (jnp; formats.quants.pack_q40_device is the numpy twin for the load
+    path). Values must already be in [-8, 7]."""
+    *lead, inner, out = w.q.shape
+    blk = w.q.astype(jnp.int32).reshape(
+        *lead, inner // Q_BLOCK, Q_BLOCK, out
+    )
+    lo = blk[..., : Q_BLOCK // 2, :] + 8
+    hi = blk[..., Q_BLOCK // 2 :, :] + 8
+    b = lo | (hi << 4)  # [0, 255]
+    qp = jnp.where(b >= 128, b - 256, b).astype(jnp.int8)
+    return PackedQuantWeight(
+        qp.reshape(*lead, inner // 2, out), w.d.astype(jnp.float16)
+    )
+
+
+def unpack_nibbles(qp: jnp.ndarray) -> jnp.ndarray:
+    """Packed nibble bytes [..., in // 2, out] -> int values
+    [..., in, out] int32 in [-8, 7], restoring the wire's intra-block
+    (j, j + 16) pairing. Shapes stay 2D-tiled the whole way (reshape /
+    concat touch the second-to-last axis only), so the same code runs
+    inside the Pallas kernel's VMEM tiles."""
+    *lead, half, out = qp.shape
+    u = qp.astype(jnp.int32) & 0xFF
+    blk = u.reshape(*lead, half // (Q_BLOCK // 2), Q_BLOCK // 2, out)
+    lo = (blk & 0xF) - 8
+    hi = (blk >> 4) - 8
+    q = jnp.concatenate([lo, hi], axis=-2)  # [..., nb, 32, out]
+    return q.reshape(*lead, half * 2, out)
+
+
+def dequant_packed(w: PackedQuantWeight, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """[..., in, out] dense tensor from the packed-nibble layout; computes
+    exactly what `dequant` computes on the unpacked equivalent (same int
+    values, same f16-exact scales)."""
+    *lead, half, out = w.qp.shape
+    inner = half * 2
+    q = unpack_nibbles(w.qp).astype(jnp.float32)
+    q = q.reshape(*lead, inner // Q_BLOCK, Q_BLOCK, out)
+    dense = q * w.d.astype(jnp.float32)[..., :, None, :]
+    return dense.reshape(*lead, inner, out).astype(dtype)
+
+
+def qmatmul_ref(x: jnp.ndarray, w) -> jnp.ndarray:
     """Reference path: dequant + dense matmul. x [..., in] -> [..., out] f32.
-    Used for equivalence tests and as the off-TPU fallback."""
-    dense = dequant(w, jnp.float32)
+    Used for equivalence tests and as the off-TPU fallback. Accepts both
+    QuantWeight and PackedQuantWeight."""
+    if isinstance(w, PackedQuantWeight):
+        dense = dequant_packed(w, jnp.float32)
+    else:
+        dense = dequant(w, jnp.float32)
     return jnp.einsum("...i,io->...o", x.astype(jnp.float32), dense)
 
 
@@ -124,6 +204,52 @@ def _qmm_kernel(x_ref, q_ref, d_ref, o_ref, acc_ref, *, n_k: int):
         (
             q.astype(jnp.float32).reshape(bk // Q_BLOCK, Q_BLOCK, bn)
             * d[:, None, :]
+        )
+        .reshape(bk, bn)
+        .astype(jnp.bfloat16)
+    )
+    partial_out = jax.lax.dot_general(
+        x_ref[:],
+        w,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(pk == 0)
+    def _init():
+        acc_ref[:] = partial_out
+
+    @pl.when(pk > 0)
+    def _accum():
+        acc_ref[:] += partial_out
+
+    @pl.when(pk == n_k - 1)
+    def _emit():
+        o_ref[:] = acc_ref[:]
+
+
+def _qmm_i4_kernel(x_ref, qp_ref, d_ref, o_ref, acc_ref, *, n_k: int):
+    """One (m, block_n) output tile from packed-nibble weights: the
+    HBM->VMEM copy moves 0.5625 B/weight, then shift/mask unpack +
+    sublane-broadcast dequant in VMEM feed the MXU in bf16 exactly like
+    the int8 kernel. The unpack is a handful of VPU element-ops per tile;
+    the Q40 kernel was already dequant-compute-bound at 46% of HBM peak
+    (docs/silicon_r03.md), so halving bytes moves the balance point, and
+    the staged bench sweep (BENCH_SWEEP_FORMATS) measures which side
+    wins on silicon."""
+    pk = pl.program_id(1)
+    qp = qp_ref[:]  # [bk // 2, bn] int8, two nibbles per byte
+    d = d_ref[:]  # [bk // 32, bn] f16
+    half, bn = qp.shape
+    bk = half * 2
+    u = qp.astype(jnp.int32) & 0xFF
+    blk = u.reshape(bk // Q_BLOCK, Q_BLOCK // 2, bn)
+    lo = (blk & 0xF) - 8
+    hi = (blk >> 4) - 8
+    w = (
+        (
+            jnp.concatenate([lo, hi], axis=1).astype(jnp.float32)
+            * d.astype(jnp.float32)[:, None, :]
         )
         .reshape(bk, bn)
         .astype(jnp.bfloat16)
@@ -202,16 +328,63 @@ def qmatmul_2d(
     )(x.astype(jnp.bfloat16), q, d)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("block_n", "block_k", "interpret")
+)
+def qmatmul_i4_2d(
+    x: jnp.ndarray,  # [m, k]
+    qp: jnp.ndarray,  # [k // 2, n] int8 packed nibbles
+    d: jnp.ndarray,  # [k // 32, n] f16
+    block_n: int = 256,
+    block_k: int = 4096,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas packed-nibble quantized matmul; returns [m, n] f32.
+
+    Same grid/accumulator structure as `qmatmul_2d` (k innermost so the
+    output tile stays live in VMEM scratch); the weight BlockSpec moves
+    half the rows because each byte carries two values. Block defaults
+    inherit the int8 sweep winner — at equal (bn, bk) the packed DMA is
+    half the bytes, so the VMEM ceiling moves further out, and the
+    staged silicon sweep re-tunes on hardware."""
+    m, k = x.shape
+    n = qp.shape[1]
+    assert qp.shape == (k // 2, n) and d.shape == (k // Q_BLOCK, n), (
+        qp.shape,
+        d.shape,
+    )
+    bn = _pick_block(n, block_n)
+    bk = _pick_block(k, block_k)
+    assert bk % Q_BLOCK == 0
+
+    n_k = k // bk
+    grid = (n // bn, n_k)
+    return pl.pallas_call(
+        functools.partial(_qmm_i4_kernel, n_k=n_k),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((m, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((bk // 2, bn), lambda i, j: (j, i)),
+            pl.BlockSpec((bk // Q_BLOCK, bn), lambda i, j: (j, i)),
+        ],
+        out_specs=pl.BlockSpec((m, bn), lambda i, j: (0, i)),
+        scratch_shapes=[pltpu.VMEM((m, bn), jnp.float32)],
+        interpret=interpret,
+    )(x.astype(jnp.bfloat16), qp, d)
+
+
 def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def qmatmul(x: jnp.ndarray, w: QuantWeight, block_n: int = 256) -> jnp.ndarray:
+def qmatmul(x: jnp.ndarray, w, block_n: int = 256) -> jnp.ndarray:
     """x [..., in] @ W -> [..., out] f32, auto-flattening leading dims.
 
-    Dispatches to the Pallas kernel on TPU; off-TPU (CPU test meshes) uses
-    the dequant reference path — pallas interpret mode is orders of
-    magnitude slower and numerically identical anyway.
+    Accepts QuantWeight (int8 values) or PackedQuantWeight (nibble-packed).
+    Dispatches to the matching Pallas kernel on TPU; off-TPU (CPU test
+    meshes) uses the dequant reference path — pallas interpret mode is
+    orders of magnitude slower and numerically identical anyway.
     """
     *lead, k = x.shape
     if not _use_pallas():
@@ -219,13 +392,16 @@ def qmatmul(x: jnp.ndarray, w: QuantWeight, block_n: int = 256) -> jnp.ndarray:
     m = 1
     for s in lead:
         m *= s
-    out = qmatmul_2d(x.reshape(m, k), w.q, w.d, block_n=block_n)
+    if isinstance(w, PackedQuantWeight):
+        out = qmatmul_i4_2d(x.reshape(m, k), w.qp, w.d, block_n=block_n)
+    else:
+        out = qmatmul_2d(x.reshape(m, k), w.q, w.d, block_n=block_n)
     return out.reshape(*lead, w.out_dim)
 
 
 def qmatmul_tp(
     x: jnp.ndarray,  # [B, T, in]
-    w: QuantWeight,  # [in, out] (+ scales), possibly tp-sharded
+    w,  # QuantWeight | PackedQuantWeight [in, out] (+ scales), tp-shardable
     role: str,  # "row" (out split) | "col" (in split, partial-sum psum)
     mesh=None,
     sync_quant: bool = False,  # Q80-compress the col-split partial-sum
@@ -249,8 +425,15 @@ def qmatmul_tp(
     if mesh is None or mesh.devices.size == 1:
         return qmatmul(x, w)
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map_compat
+
+    # both weight classes are (values, scales) NamedTuples whose leaves
+    # shard identically: the packed in/2 axis and the in/32 scale axis
+    # both divide by tp under the engine's 32*tp divisibility check
+    cls = type(w)
+    values, scales = w
 
     if role == "row":
         in_specs = (
@@ -261,7 +444,7 @@ def qmatmul_tp(
         out_spec = P("dp", None, "tp")
 
         def f(xx, qq, dd):
-            return qmatmul(xx, QuantWeight(qq, dd))
+            return qmatmul(xx, cls(qq, dd))
 
     elif role == "col":
         from ..parallel.collectives import psum_maybe_quantized
@@ -275,12 +458,12 @@ def qmatmul_tp(
 
         def f(xx, qq, dd):
             return psum_maybe_quantized(
-                qmatmul(xx, QuantWeight(qq, dd)), "tp", sync_quant
+                qmatmul(xx, cls(qq, dd)), "tp", sync_quant
             )
 
     else:
         raise ValueError(f"unknown role: {role}")
 
-    return shard_map(
+    return shard_map_compat(
         f, mesh=mesh, in_specs=in_specs, out_specs=out_spec, check_vma=False
-    )(x, w.q, w.d)
+    )(x, values, scales)

@@ -29,7 +29,16 @@ from ..formats.model_file import LlmArch, LlmHeader
 
 
 def param_spec_tree(h: LlmHeader) -> dict[str, Any]:
-    """PartitionSpecs matching the params pytree from models/loader.py."""
+    """PartitionSpecs matching the params pytree from models/loader.py.
+
+    The same specs cover every quantized device format's leaves: a
+    QuantWeight/PackedQuantWeight/Int8Weight is a (values, scales) pytree
+    whose leaves all keep the [in-ish, out] axis order — row split puts
+    "tp" on the last (out) axis of both leaves, col split on the
+    second-to-last. For the packed q40i4 layout the value leaf's in axis
+    is in//2 and the scale leaf's is in//32; both divide by tp under the
+    engine's 32*tp divisibility check, so the col shard boundaries stay
+    nibble- and block-aligned."""
     moe = h.arch == LlmArch.QWEN3_MOE
     # stacked layer weights carry a leading layer axis; MoE adds an expert axis
     row = P(None, None, None, "tp") if moe else P(None, None, "tp")  # out split

@@ -240,13 +240,13 @@ class InferenceEngine:
                 )
                 else "dense"
             )
-        if weight_format not in ("dense", "q40", "q40i8"):
+        if weight_format not in ("dense", "q40", "q40i8", "q40i4"):
             raise ValueError(
-                f"weight_format must be 'auto', 'dense', 'q40' or 'q40i8', "
-                f"got {weight_format!r}"
+                f"weight_format must be 'auto', 'dense', 'q40', 'q40i8' or "
+                f"'q40i4', got {weight_format!r}"
             )
         self.weight_format = weight_format
-        quantized = weight_format in ("q40", "q40i8")
+        quantized = weight_format in ("q40", "q40i8", "q40i4")
         # Q80-compressed partial-sum all-reduces (the reference's
         # --buffer-float-type q80, src/llm.cpp:195): worthwhile on
         # DCN-connected multi-host pods where sync bytes are the
@@ -275,8 +275,9 @@ class InferenceEngine:
             self.reader,
             dtype=dtype,
             put=shard_params_put(self.mesh, self.header),
-            # q40i8 loads the wire's Q40 blocks first, then requantizes
-            weight_format="q40" if quantized else weight_format,
+            # q40i8 loads the wire's Q40 blocks first, then requantizes;
+            # q40i4 packs host-side inside the loader itself
+            weight_format="q40" if weight_format == "q40i8" else weight_format,
             # quantized path: fuse q|k|v (and w1|w3 for dense-FFN archs)
             # into single shard-major-interleaved kernel launches — 7 -> 4
             # Pallas calls per decode layer (~41 us fixed cost each,
@@ -623,6 +624,21 @@ class InferenceEngine:
         def work():
             try:
                 builder()
+            except Exception:
+                # a daemon thread dies silently by default: the boundary
+                # crossing would then fall back to a synchronous compile
+                # every window with nothing in the logs explaining the p99
+                # stalls. Log it and mark the key so telemetry/tests can
+                # see the prefetch path is broken.
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "AOT prefetch failed for %r; the window boundary will "
+                    "compile synchronously",
+                    key,
+                )
+                with self._compile_lock:
+                    self._compile_origin[key] = "prefetch-failed"
             finally:
                 with self._compile_lock:
                     self._inflight.pop(key, None)

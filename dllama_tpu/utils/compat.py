@@ -1,0 +1,32 @@
+"""Version shims for the jax API surface this repo spans.
+
+`shard_map` moved from `jax.experimental.shard_map` to the `jax` top level,
+and its replication-check kwarg was renamed `check_rep` -> `check_vma` along
+the way. Every call site goes through `shard_map_compat` so the rest of the
+codebase can use the modern spelling on either jax.
+"""
+
+from __future__ import annotations
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    try:
+        from jax import shard_map as _shard_map  # jax >= 0.6
+
+        return _shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        return _shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_vma,
+        )

@@ -471,7 +471,7 @@ def test_flash_stats_strided_matches_jnp():
 def test_ring_cyclic_flash_local_step():
     """ring_attention_local in cyclic mode with the flash local step ==
     jnp local step (interpret mode, 4 shards)."""
-    from jax import shard_map
+    from dllama_tpu.utils.compat import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
     from dllama_tpu.parallel.ring_attention import ring_attention_local
 
@@ -662,7 +662,7 @@ def test_ring_cyclic_flash_quantkv():
     """ring_attention_local in cyclic mode over a QuantKV shard: flash
     local step (int8-native) == jnp local step (local dequant); the ring
     rotates int8 payloads either way."""
-    from jax import shard_map
+    from dllama_tpu.utils.compat import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
     from dllama_tpu.ops.kv_cache import QuantKV
     from dllama_tpu.parallel.ring_attention import ring_attention_local

@@ -9,6 +9,11 @@ from dllama_tpu.formats.model_file import LlmArch, RopeType
 
 from helpers import TINY, make_tiny_model, make_tiny_tokenizer
 
+# sub-minute CPU-only surface (codecs, tokenizer, native loader,
+# interpret-mode kernel parity): the first CI lane runs `pytest -m fast`
+pytestmark = pytest.mark.fast
+
+
 
 def test_header_roundtrip(tmp_path):
     path = tmp_path / "tiny.m"

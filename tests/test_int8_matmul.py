@@ -11,6 +11,7 @@ import pytest
 from dllama_tpu.formats.quants import q40_to_planar, quantize_q40
 from dllama_tpu.ops import quant_matmul as qm
 from dllama_tpu.ops.int8_matmul import (
+
     Int8Weight,
     i8matmul,
     i8matmul_2d,
@@ -18,6 +19,10 @@ from dllama_tpu.ops.int8_matmul import (
     quantize_acts,
     requantize_q40,
 )
+
+# sub-minute CPU-only surface (codecs, tokenizer, native loader,
+# interpret-mode kernel parity): the first CI lane runs `pytest -m fast`
+pytestmark = pytest.mark.fast
 
 
 def _q40(rng, k, n, scale=0.1):

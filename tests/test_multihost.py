@@ -31,7 +31,7 @@ initialize_multihost(
 )
 import numpy as np
 import jax.numpy as jnp
-from jax import shard_map
+from dllama_tpu.utils.compat import shard_map_compat as shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 assert jax.process_count() == 2, jax.process_count()

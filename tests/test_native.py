@@ -6,6 +6,11 @@ import pytest
 from dllama_tpu.formats.quants import dequantize_q40, q40_to_planar, quantize_q40
 from dllama_tpu.utils import native
 
+# sub-minute CPU-only surface (codecs, tokenizer, native loader,
+# interpret-mode kernel parity): the first CI lane runs `pytest -m fast`
+pytestmark = pytest.mark.fast
+
+
 
 @pytest.fixture(scope="module")
 def lib():

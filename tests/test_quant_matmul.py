@@ -7,6 +7,7 @@ import pytest
 
 from dllama_tpu.formats.quants import quantize_q40, q40_to_planar
 from dllama_tpu.ops.quant_matmul import (
+
     QuantWeight,
     dequant,
     from_planar,
@@ -14,6 +15,10 @@ from dllama_tpu.ops.quant_matmul import (
     qmatmul_2d,
     qmatmul_ref,
 )
+
+# sub-minute CPU-only surface (codecs, tokenizer, native loader,
+# interpret-mode kernel parity): the first CI lane runs `pytest -m fast`
+pytestmark = pytest.mark.fast
 
 
 def make_qw(n, k, seed=0):
@@ -198,6 +203,134 @@ def test_fused_quant_loader_matches_split(tmp_path):
     parts = _split_fused(out, tp, dims)
     for part, w in zip(parts, qws):
         expect = qmatmul_ref(x, w)
+        np.testing.assert_allclose(
+            np.asarray(part), np.asarray(expect), rtol=0, atol=1e-5
+        )
+
+
+# ---------------------------------------------------------------- packed int4
+
+
+def make_packed(n, k, seed=0):
+    """(PackedQuantWeight, QuantWeight, dense) triple for [out=n, in=k]."""
+    from dllama_tpu.ops.quant_matmul import pack_nibbles
+
+    qw, w = make_qw(n, k, seed=seed)
+    return pack_nibbles(qw), qw, w
+
+
+def test_pack_nibbles_roundtrip():
+    from dllama_tpu.ops.quant_matmul import unpack_nibbles
+
+    pw, qw, _ = make_packed(64, 128)
+    assert pw.qp.shape == (64, 64) == (qw.q.shape[0] // 2, qw.q.shape[1])
+    assert pw.d.dtype == jnp.float16
+    np.testing.assert_array_equal(
+        np.asarray(unpack_nibbles(pw.qp)), np.asarray(qw.q, dtype=np.int32)
+    )
+
+
+def test_host_pack_matches_device_pack():
+    """formats.pack_q40_device (numpy, loader path) produces the exact
+    bytes of ops.pack_nibbles (jnp, requantize path)."""
+    from dllama_tpu.formats.quants import pack_q40_device
+
+    pw, qw, _ = make_packed(128, 256, seed=5)
+    qp_np, d_np = pack_q40_device(np.asarray(qw.q), np.asarray(qw.d))
+    np.testing.assert_array_equal(qp_np, np.asarray(pw.qp))
+    np.testing.assert_array_equal(d_np, np.asarray(pw.d))
+
+
+def test_dequant_packed_matches_dequant():
+    """f16 scales are wire-exact (Q40 stores fp16 scales), so the packed
+    dequant is bit-identical to the int8 dequant."""
+    from dllama_tpu.ops.quant_matmul import dequant_packed
+
+    pw, qw, _ = make_packed(64, 128, seed=2)
+    np.testing.assert_array_equal(
+        np.asarray(dequant_packed(pw, jnp.float32)),
+        np.asarray(dequant(qw, jnp.float32)),
+    )
+
+
+@pytest.mark.parametrize("m,n,k", [(1, 256, 512), (8, 512, 256), (16, 256, 1024)])
+def test_packed_kernel_matches_reference(m, n, k):
+    """Interpret-mode int4 kernel vs the dequant einsum AND vs the int8
+    kernel on the unpacked twin (in-kernel nibble unpack is exact, so the
+    two kernels agree bit-for-bit)."""
+    from dllama_tpu.ops.quant_matmul import qmatmul_i4_2d
+
+    pw, qw, _ = make_packed(n, k, seed=1)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((m, k)).astype(np.float32))
+    expected = np.asarray(qmatmul_ref(x.astype(jnp.bfloat16).astype(jnp.float32), qw))
+    got = np.asarray(
+        qmatmul_i4_2d(x, pw.qp, pw.d, block_n=128, interpret=True)
+    )
+    np.testing.assert_allclose(got, expected, rtol=2e-2, atol=2e-2)
+    int8 = np.asarray(qmatmul_2d(x, qw.q, qw.d, block_n=128, interpret=True))
+    np.testing.assert_array_equal(got, int8)
+
+
+def test_packed_bytes_per_weight():
+    """The device residency win the format exists for: ≤ 0.60 B/weight
+    including scales (0.5 packed nibbles + 2/32 f16 scale = 0.5625)."""
+    pw, qw, _ = make_packed(256, 512)
+    n_weights = 256 * 512
+    packed_bytes = pw.qp.nbytes + pw.d.nbytes
+    assert packed_bytes / n_weights <= 0.60
+    assert pw.qp.nbytes * 2 == qw.q.nbytes  # exactly half the value bytes
+
+
+def test_packed_qmatmul_dispatch():
+    """qmatmul auto-dispatches on the weight class (ref path off-TPU)."""
+    from dllama_tpu.ops.quant_matmul import PackedQuantWeight
+
+    pw, qw, _ = make_packed(128, 256, seed=3)
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((2, 3, 256)).astype(np.float32))
+    out = qmatmul(x, pw)
+    assert out.shape == (2, 3, 128)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(qmatmul_ref(x, qw)), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_packedquantweight_is_pytree():
+    import jax
+
+    from dllama_tpu.ops.quant_matmul import PackedQuantWeight
+
+    pw, _, _ = make_packed(64, 64)
+    stacked = jax.tree.map(lambda a: jnp.stack([a, a]), pw)
+    assert isinstance(stacked, PackedQuantWeight)
+    assert stacked.qp.shape == (2, 32, 64)
+    assert len(jax.tree.leaves(pw)) == 2
+
+
+def test_fused_packed_matches_split():
+    """Interleave (out-axis permutation) commutes with packing (in-axis
+    halving): packing the fused int8 weight equals fusing then packing,
+    and the fused packed ref output un-interleaves to the split results."""
+    from dllama_tpu.models.loader import _interleave_concat
+    from dllama_tpu.models.transformer import _split_fused
+    from dllama_tpu.ops.quant_matmul import pack_nibbles
+
+    tp = 2
+    k = 128
+    dims = (64, 64, 64)
+    qws = [make_qw(d, k, seed=20 + i)[0] for i, d in enumerate(dims)]
+    fused = QuantWeight(
+        jnp.asarray(_interleave_concat([np.asarray(w.q) for w in qws], tp)),
+        jnp.asarray(_interleave_concat([np.asarray(w.d) for w in qws], tp)),
+    )
+    pfused = pack_nibbles(fused)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((1, 1, k)).astype(np.float32))
+    out = qmatmul_ref(x, pfused)
+    parts = _split_fused(out, tp, dims)
+    for part, w in zip(parts, qws):
+        expect = qmatmul_ref(x, pack_nibbles(w))
         np.testing.assert_allclose(
             np.asarray(part), np.asarray(expect), rtol=0, atol=1e-5
         )

@@ -20,6 +20,11 @@ from dllama_tpu.formats import (
 )
 from dllama_tpu.formats.quants import FloatType, quantize_q80_values
 
+# sub-minute CPU-only surface (codecs, tokenizer, native loader,
+# interpret-mode kernel parity): the first CI lane runs `pytest -m fast`
+pytestmark = pytest.mark.fast
+
+
 # Golden hex of Q40(torch.manual_seed(1); torch.randn(32, 16)) — identical to
 # the reference's converter/writer-test.py EXPECTED_OUTPUT.
 GOLDEN_Q40_HEX = (

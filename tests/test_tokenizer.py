@@ -20,6 +20,11 @@ from dllama_tpu.runtime.sampler import Sampler, XorshiftRng, softmax
 
 from helpers import make_tiny_tokenizer
 
+# sub-minute CPU-only surface (codecs, tokenizer, native loader,
+# interpret-mode kernel parity): the first CI lane runs `pytest -m fast`
+pytestmark = pytest.mark.fast
+
+
 
 @pytest.fixture()
 def tok(tmp_path):
