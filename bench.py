@@ -186,6 +186,134 @@ def _tunnel_probe_retry() -> bool:
     return False
 
 
+def _serving_smoke(n_clients: int) -> dict:
+    """Serving-load smoke (BENCH_SERVING=N): drive N concurrent streaming
+    requests against a tiny synthetic model through the real HTTP server +
+    LaneScheduler, then report TTFT/queue-wait from the request traces,
+    the /metrics histogram counts, and the instrumentation on/off decode
+    overhead (ISSUE 2 acceptance: within 1% — the hooks are one histogram
+    observe per block dispatch)."""
+    import http.client
+    import re
+    import tempfile
+    import threading
+
+    from dllama_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer
+    from dllama_tpu.models.synthetic import write_synth_model
+    from dllama_tpu.obs import get_registry
+    from dllama_tpu.obs.trace import read_jsonl
+    from dllama_tpu.runtime.api_server import serve
+    from dllama_tpu.runtime.engine import InferenceEngine
+    from dllama_tpu.tokenizer import Tokenizer
+
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=288, seq_len=256)
+    d = tempfile.mkdtemp(prefix="bench-serving-")
+    model_path = os.path.join(d, "model.m")
+    tok_path = os.path.join(d, "tok.t")
+    trace_path = os.path.join(d, "trace.jsonl")
+    write_synth_model(model_path, cfg, max_seq_len=cfg["seq_len"])
+    # byte-level tokenizer padded to the model vocab, specials at the top
+    vocab = [bytes([i]) for i in range(256)]
+    specials = [b"<s>", b"</s>", b"<|eot|>"]
+    while len(vocab) < cfg["vocab_size"] - len(specials):
+        vocab.append(f"<pad{len(vocab)}>".encode())
+    bos_id = len(vocab)
+    vocab += specials
+    write_tokenizer(tok_path, TokenizerData(
+        vocab=vocab,
+        scores=[0.0] * len(vocab),
+        bos_id=bos_id,
+        add_bos=True,
+        eos_token_ids=[bos_id + 1, bos_id + 2],
+        chat_template="<|start_header_id|>",  # llama3-shaped template probe
+        max_token_length=max(len(v) for v in vocab),
+    ))
+    tok = Tokenizer(tok_path)
+    n_lanes = max(2, n_clients)
+    engine = InferenceEngine(
+        model_path, tokenizer=tok, batch_size=n_lanes, temperature=0.0
+    )
+    srv = serve(
+        engine, tok, host="127.0.0.1", port=0, trace_out=trace_path
+    )
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def one_request(i: int) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        conn.request(
+            "POST", "/v1/chat/completions",
+            json.dumps({
+                "messages": [{"role": "user", "content": f"hello {i}"}],
+                "max_tokens": 16, "stream": True,
+            }),
+            {"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        r.read()
+        conn.close()
+
+    threads = [
+        threading.Thread(target=one_request, args=(i,))
+        for i in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", "/metrics")
+    metrics_text = conn.getresponse().read().decode("utf-8")
+    conn.close()
+    srv.shutdown()
+
+    def hist_count(name: str) -> int:
+        m = re.search(rf"^{name}_count (\d+)", metrics_text, re.M)
+        return int(m.group(1)) if m else 0
+
+    recs = [r for r in read_jsonl(trace_path) if r["ttft_s"] is not None]
+    ttfts = sorted(r["ttft_s"] * 1000 for r in recs)
+    waits = sorted(r["queue_wait_s"] * 1000 for r in recs)
+
+    # instrumentation overhead: median decode-block wall time with the
+    # registry enabled vs disabled (same compiled program, same lanes)
+    reg = get_registry()
+
+    def median_block_s(k: int = 9) -> float:
+        times = []
+        for _ in range(k):
+            t0 = time.perf_counter()
+            engine.decode_lanes(
+                [1] * n_lanes, [64] * n_lanes, 8,
+                active=[True] * n_lanes,
+            )
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[k // 2]
+
+    engine.decode_lanes(  # warm the compiled program
+        [1] * n_lanes, [64] * n_lanes, 8, active=[True] * n_lanes
+    )
+    on_s = median_block_s()
+    reg.disable()
+    off_s = median_block_s()
+    reg.enable()
+    overhead_pct = (on_s - off_s) / off_s * 100.0 if off_s > 0 else 0.0
+
+    return {
+        "n_clients": n_clients,
+        "n_traced": len(recs),
+        "ttft_ms_p50": round(ttfts[len(ttfts) // 2], 2) if ttfts else None,
+        "queue_wait_ms_p50": (
+            round(waits[len(waits) // 2], 3) if waits else None
+        ),
+        "ttft_hist_count": hist_count("dllama_ttft_seconds"),
+        "tpot_hist_count": hist_count("dllama_tpot_seconds"),
+        "obs_overhead_pct": round(overhead_pct, 2),
+    }
+
+
 _partial_result: dict = {}
 _wall_timer = None
 
@@ -462,9 +590,19 @@ def main() -> None:
             log(f"sweep {fmt}: {sweep_results[fmt]} tok/s/chip")
             del cache_f
 
+    # serving-load smoke (BENCH_SERVING=N concurrent streams through the
+    # real HTTP server; tiny synthetic model, so it rides any preset)
+    serving = None
+    n_serving = int(os.environ.get("BENCH_SERVING", "0"))
+    if n_serving > 0:
+        serving = _serving_smoke(n_serving)
+        log(f"serving smoke: {serving}")
+
     if _wall_timer is not None:
         _wall_timer.cancel()  # exactly ONE JSON line on a healthy run
     result = dict(_partial_result)
+    if serving is not None:
+        result["serving"] = serving
     if ttft_p50 is not None:
         result["ttft_ms_p50"] = round(ttft_p50, 1)
     if lanes_tok_s is not None:

@@ -82,6 +82,11 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                         "nibbles (0.56 B/weight, in-kernel unpack)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="append one JSON line per finished request/"
+                        "generation (request id, queue wait, prefill "
+                        "span, TTFT, token counts, finish reason) to "
+                        "PATH (obs/trace.py)")
     p.add_argument("--moe-decode-dedup", default="auto", nargs="?",
                    const="on",  # bare flag keeps its r4 meaning (force on)
                    choices=["auto", "on", "off"],
@@ -266,6 +271,18 @@ def run_inference(args) -> None:
     )
     sync_eval = sync_pred = None
 
+    # one JSONL record for the whole generation, same schema as the API
+    # server's --trace-out sink (obs/trace.py)
+    from .obs.trace import NULL_SPAN, Tracer
+
+    tracer = (
+        Tracer(sink_path=args.trace_out)
+        if getattr(args, "trace_out", None)
+        else None
+    )
+    span = tracer.span(path="cli") if tracer is not None else NULL_SPAN
+    span.mark_admitted()
+
     print(args.prompt)
     with profile(args.profile):
         if measure:
@@ -276,6 +293,7 @@ def run_inference(args) -> None:
                 lambda: engine.prefill(tokens), steps=1
             )
         eval_stats = engine.prefill(tokens)
+        span.set_prefill_seconds(eval_stats.time_ms / 1000.0)
         eval_kb = (
             per_tok_bytes * max(eval_stats.n_tokens, 1) + logits_bytes
         ) // 1024
@@ -301,6 +319,8 @@ def run_inference(args) -> None:
             pos += 1
             pred_ms += stats.time_ms
             n_pred += 1
+            if n_pred == 1:
+                span.mark_first_token()
             piece = tok.decode(token)
             step_kb = (per_tok_bytes + logits_bytes) // 1024
             pred_sync = (
@@ -312,6 +332,10 @@ def run_inference(args) -> None:
                 f"{piece if piece is not None else chr(126)}"
             )
             sys.stdout.flush()
+
+    span.finish("length", n_prompt=len(tokens), n_completion=n_pred)
+    if tracer is not None:
+        tracer.close()
 
     n_eval = max(len(tokens) - 1, 1)
     print()

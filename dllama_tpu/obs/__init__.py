@@ -1,0 +1,31 @@
+"""Serving observability: metrics registry + request lifecycle tracing.
+
+Zero-dependency (stdlib-only) quantitative evidence for the serving path —
+the counters/gauges/histograms behind ``GET /metrics`` (Prometheus text
+format) and the per-request JSONL traces behind ``--trace-out``. The
+ROADMAP's north star is serving heavy traffic "as fast as the hardware
+allows"; this package is how that claim gets numbers instead of vibes
+(TTFT, per-token latency, queue wait, lane occupancy, prefix-cache hits).
+
+All hooks are no-ops when the registry is disabled (``DLLAMA_OBS=0`` or
+``get_registry().disable()``); an enabled histogram observation is an O(1)
+bucket increment under a short lock.
+"""
+
+from .metrics import (
+    DEFAULT_LATENCY_BUCKETS_S,
+    DEFAULT_TOKEN_BUCKETS_S,
+    MetricsRegistry,
+    get_registry,
+)
+from .trace import NULL_SPAN, RequestSpan, Tracer
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS_S",
+    "DEFAULT_TOKEN_BUCKETS_S",
+    "MetricsRegistry",
+    "get_registry",
+    "NULL_SPAN",
+    "RequestSpan",
+    "Tracer",
+]

@@ -6,6 +6,9 @@ Capability port of the reference's `dllama-api` (src/dllama-api.cpp):
   ``temperature``, ``seed``, ``max_tokens``, ``stop`` parameters
   (src/dllama-api.cpp:491-520);
 * ``GET /v1/models`` — single-model listing (src/dllama-api.cpp:538-547);
+* ``GET /metrics`` — Prometheus text exposition of the serving/engine
+  metrics (obs/metrics.py; see docs/serving_metrics.md);
+* ``GET /v1/health`` — model name, lane occupancy, queue depth, uptime;
 * **NaiveCache** — KV positions are reused when a new request's messages
   are a strict superset of the previous conversation
   (src/dllama-api.cpp:298-343).
@@ -29,6 +32,8 @@ import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..obs.metrics import DEFAULT_TOKEN_BUCKETS_S, get_registry
+from ..obs.trace import NULL_SPAN, Tracer
 from ..tokenizer import (
     CHAT_TEMPLATE_NAMES,
     ChatItem,
@@ -126,6 +131,10 @@ class LaneJob:
         self.n_completion = 0
         self.buffer = ""
         self.cancelled = False
+        # lifecycle span (obs/trace.py): submit() swaps in a live one; the
+        # scheduler marks admit/first-token/finish, the handler reads the
+        # derived metadata for the response
+        self.span = NULL_SPAN
 
 
 @dataclass
@@ -184,10 +193,17 @@ class LaneScheduler:
 
     def submit(self, params: InferenceParams) -> LaneJob:
         job = LaneJob(params)
+        job.span = self.state.tracer.span(path="lanes")
         with self.cv:
             self.pending.append(job)
+            self.state.m_queue_depth.set(len(self.pending))
             self.cv.notify()
         return job
+
+    def _set_lane_gauge(self) -> None:
+        self.state.m_lanes_active.set(
+            sum(1 for ls in self.lanes if ls is not None)
+        )
 
     # -- scheduler thread --------------------------------------------------
 
@@ -214,9 +230,18 @@ class LaneScheduler:
                         ),
                     )
                     free.remove(lane)
+                    if (
+                        self.lane_cache[lane].items
+                        and self.lane_cache[lane].probe(job.params.messages)
+                        == 0
+                    ):
+                        # a fresh conversation takes a lane that still held
+                        # another conversation's reusable prefix
+                        self.state.m_evictions.inc()
                     self._admission_count += 1
                     self.lane_used[lane] = self._admission_count
                     admissions.append((lane, job))
+                self.state.m_queue_depth.set(len(self.pending))
             for lane, job in admissions:
                 self._admit(lane, job)
             if any(self.lanes):
@@ -230,12 +255,27 @@ class LaneScheduler:
                     # failed dispatch donated the KV cache buffer, so NO
                     # lane's cached conversation can be trusted afterwards
                     # — drop them all rather than resume on corrupt KV.
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "lane scheduler step failed; dropping all "
+                        "in-flight lanes"
+                    )
+                    self.state.m_sched_errors.inc()
                     for lane in range(len(self.lanes)):
                         if self.lanes[lane] is not None:
-                            self.lanes[lane].job.events.put(("error", str(e)))
+                            job = self.lanes[lane].job
+                            job.events.put(("error", str(e)))
+                            if job.span.finish(
+                                "error", n_completion=job.n_completion
+                            ):
+                                self.state.m_finished.labels(
+                                    reason="error"
+                                ).inc()
                             self.lanes[lane] = None
                         self.lane_cache[lane].clear()
                         self.lane_pending[lane] = None
+                    self._set_lane_gauge()
                     with self.cv:
                         self.cv.notify_all()
 
@@ -246,6 +286,16 @@ class LaneScheduler:
         try:
             cache = self.lane_cache[lane]
             delta_prompt, start_pos = cache.resolve_delta_prompt(p.messages)
+            if start_pos > 0:
+                state.m_prefix_hits.inc()
+                state.m_reused_tokens.inc(start_pos)
+            else:
+                state.m_prefix_misses.inc()
+            qw = job.span.mark_admitted(
+                lane=lane, reused_prefix_tokens=start_pos
+            )
+            state.m_queue_wait.observe(qw)
+            state.m_admissions.inc()
             pending = self.lane_pending[lane] if start_pos > 0 else None
             if start_pos == 0:
                 self.lane_pending[lane] = None
@@ -280,7 +330,12 @@ class LaneScheduler:
             # positions), so a seeded request reproduces regardless of
             # which other lanes are active or how blocks split.
             engine_touched = True
+            t0 = time.perf_counter()
             engine.prefill_lane(lane, tokens, pos0=pos0)
+            pf = time.perf_counter() - t0
+            job.span.set_prefill_seconds(pf)
+            job.span.set_tokens(n_prompt=len(tokens))
+            state.m_prefill.observe(pf)
             if prompt.public_prompt:
                 job.buffer += prompt.public_prompt
                 job.events.put(("delta", prompt.public_prompt))
@@ -304,8 +359,11 @@ class LaneScheduler:
                 delta_messages=list(delta_prompt),
                 prompt_end=prompt_end,
             )
+            self._set_lane_gauge()
         except Exception as e:
             job.events.put(("error", str(e)))
+            if job.span.finish("error") is not None:
+                state.m_finished.labels(reason="error").inc()
             self.lanes[lane] = None
             if engine_touched:
                 # the prefill may have partially written this lane's cache
@@ -333,8 +391,17 @@ class LaneScheduler:
             # matches a recordable conversation
             cache.clear()
             self.lane_pending[lane] = None
+        if ls.job.span.finish(
+            reason,
+            n_prompt=ls.job.n_prompt_tokens,
+            n_completion=ls.job.n_completion,
+        ) is not None:
+            self.state.m_finished.labels(reason=reason).inc()
+            if reason == "cancelled":
+                self.state.m_cancellations.inc()
         ls.job.events.put(("done", reason))
         self.lanes[lane] = None
+        self._set_lane_gauge()
         with self.cv:
             self.cv.notify()
 
@@ -353,9 +420,15 @@ class LaneScheduler:
         temps = [ls.temperature if ls else 0.0 for ls in self.lanes]
         topps = [ls.top_p if ls else 1.0 for ls in self.lanes]
         seeds = [ls.seed if ls else None for ls in self.lanes]
+        t0 = time.perf_counter()
         rows = self.engine.decode_lanes(
             tokens, pos, self.block_size, active, temps, topps, seeds=seeds
         )
+        if rows:
+            # every active stream advanced len(rows) tokens in this block
+            self.state.m_tpot.observe(
+                (time.perf_counter() - t0) / len(rows)
+            )
         if not rows:
             for lane in range(b):
                 if self.lanes[lane] is not None:
@@ -370,6 +443,10 @@ class LaneScheduler:
                 ls.pos += 1
                 ls.token = t
                 ls.job.n_completion += 1
+                if ls.job.n_completion == 1:
+                    ttft = ls.job.span.mark_first_token()
+                    if ttft is not None:
+                        self.state.m_ttft.observe(ttft)
                 piece = ls.decoder.decode(t)
                 eos_type = ls.detector.append(t, piece)
                 if eos_type in (EosResult.NOT_EOS, EosResult.EOS):
@@ -395,10 +472,86 @@ class ApiState:
         tokenizer: Tokenizer,
         model_name: str = "dllama-tpu",
         chat_template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
+        tracer: Tracer | None = None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
+        self.start_unix = time.time()
+        # serving observability (obs/): the registry families behind
+        # GET /metrics and the tracer behind --trace-out. Handles are
+        # created up front (before the scheduler thread starts using them)
+        # so the hot path never pays a registry lookup.
+        self.obs = get_registry()
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.m_http = self.obs.counter(
+            "dllama_http_requests_total",
+            "HTTP requests by path (unknown paths fold into 'other').",
+            labelnames=("path",),
+        )
+        self.m_queue_depth = self.obs.gauge(
+            "dllama_queue_depth",
+            "Requests waiting for a free lane (lane-scheduler path).",
+        )
+        self.m_lanes_total = self.obs.gauge(
+            "dllama_lanes_total", "Serving lanes this engine exposes."
+        )
+        self.m_lanes_active = self.obs.gauge(
+            "dllama_lanes_active", "Lanes currently decoding a request."
+        )
+        self.m_queue_wait = self.obs.histogram(
+            "dllama_queue_wait_seconds",
+            "Submit -> admission wait (lane assignment or engine lock).",
+        )
+        self.m_prefill = self.obs.histogram(
+            "dllama_prefill_seconds",
+            "Prompt prefill wall time at admission.",
+        )
+        self.m_ttft = self.obs.histogram(
+            "dllama_ttft_seconds",
+            "Submit -> first generated token (time to first token).",
+        )
+        self.m_tpot = self.obs.histogram(
+            "dllama_tpot_seconds",
+            "Per-token decode latency a streaming client observes "
+            "(block wall time / tokens per lane in the block).",
+            buckets=DEFAULT_TOKEN_BUCKETS_S,
+        )
+        self.m_admissions = self.obs.counter(
+            "dllama_admissions_total", "Requests admitted into a lane."
+        )
+        self.m_prefix_hits = self.obs.counter(
+            "dllama_prefix_cache_hits_total",
+            "Admissions that reused a NaiveCache prompt prefix.",
+        )
+        self.m_prefix_misses = self.obs.counter(
+            "dllama_prefix_cache_misses_total",
+            "Admissions that prefilled from position 0.",
+        )
+        self.m_reused_tokens = self.obs.counter(
+            "dllama_reused_prefix_tokens_total",
+            "KV positions skipped thanks to NaiveCache prefix reuse.",
+        )
+        self.m_evictions = self.obs.counter(
+            "dllama_cache_evictions_total",
+            "Lane NaiveCaches overwritten by an unrelated conversation "
+            "(LRU lane choice).",
+        )
+        self.m_cancellations = self.obs.counter(
+            "dllama_sse_cancellations_total",
+            "Streaming requests whose client disconnected mid-response.",
+        )
+        self.m_finished = self.obs.counter(
+            "dllama_requests_finished_total",
+            "Completed requests by finish reason "
+            "(stop/length/cancelled/error).",
+            labelnames=("reason",),
+        )
+        self.m_sched_errors = self.obs.counter(
+            "dllama_scheduler_errors_total",
+            "Engine errors swallowed by the lane-scheduler loop (each one "
+            "dropped every in-flight lane; see the traceback log).",
+        )
         # request defaults captured once: per-request sampler mutations must
         # not leak into later requests' defaults
         self.default_temperature = engine.temperature
@@ -422,10 +575,13 @@ class ApiState:
             LaneScheduler(self) if engine.batch_size > 1 and engine.sp == 1
             else None
         )
+        self.m_lanes_total.set(
+            engine.batch_size if self.scheduler is not None else 1
+        )
 
     # -- completion ------------------------------------------------------
 
-    def complete(self, params: InferenceParams, emit) -> dict:
+    def complete(self, params: InferenceParams, emit, span=None) -> dict:
         """Run one chat completion; `emit(delta)` is called per text delta
         (streaming). Returns the non-stream response dict.
         (reference: ApiServer::complete, src/dllama-api.cpp:367-487)
@@ -441,15 +597,24 @@ class ApiState:
         does not tell us whether KV state survived; the epoch does.
         Client-caused errors raised before any dispatch leave the
         epoch, and therefore the prompt cache, untouched."""
+        if span is None:
+            span = NULL_SPAN
         epoch = self.engine.cache_epoch
         try:
-            return self._complete(params, emit)
-        except BaseException:
+            return self._complete(params, emit, span)
+        except BaseException as e:
             if self.engine.cache_epoch != epoch:
                 self.naive_cache.clear()
+            # an OSError here came from emit -> the client's socket: the
+            # request was cancelled, not broken
+            reason = "cancelled" if isinstance(e, OSError) else "error"
+            if span.finish(reason) is not None:
+                self.m_finished.labels(reason=reason).inc()
+                if reason == "cancelled":
+                    self.m_cancellations.inc()
             raise
 
-    def _complete(self, params: InferenceParams, emit) -> dict:
+    def _complete(self, params: InferenceParams, emit, span=NULL_SPAN) -> dict:
         engine, tok = self.engine, self.tokenizer
         engine.temperature = params.temperature
         engine.sampler.set_temp(params.temperature)
@@ -460,6 +625,12 @@ class ApiState:
         delta_prompt, start_pos = self.naive_cache.resolve_delta_prompt(
             params.messages
         )
+        if start_pos > 0:
+            self.m_prefix_hits.inc()
+            self.m_reused_tokens.inc(start_pos)
+        else:
+            self.m_prefix_misses.inc()
+        span.set_reused_prefix(start_pos)
         if start_pos == 0:
             engine.reset()
 
@@ -498,8 +669,17 @@ class ApiState:
         # different RNG than the reference's xorshift host sampler (which
         # remains available via engine.decode_step / Sampler).
         state = {"hit_eos": False, "buffer": buffer}
+        t_gen = time.perf_counter()
 
         def on_token(t: int):
+            ttft = span.mark_first_token()
+            if ttft is not None:
+                self.m_ttft.observe(ttft)
+                # prefill span on this path: generate() start -> first
+                # token readback (prefill + the first decode dispatch)
+                pf = time.perf_counter() - t_gen
+                span.set_prefill_seconds(pf)
+                self.m_prefill.observe(pf)
             piece = tok.decode(t)
             eos_type = detector.append(t, piece)
             if eos_type in (EosResult.NOT_EOS, EosResult.EOS):
@@ -547,13 +727,37 @@ class ApiState:
                 self.naive_cache.push(NaiveCacheItem(prompt_end_pos, m))
             self.naive_cache.push(NaiveCacheItem(pos, message))
 
+        reason = "stop" if hit_eos else "length"
+        if span.finish(
+            reason, n_prompt=n_prompt_tokens, n_completion=n_completion
+        ) is not None:
+            self.m_finished.labels(reason=reason).inc()
         return _completion_response(
             self,
             buffer,
-            "stop" if hit_eos else "length",
+            reason,
             n_prompt_tokens,
             n_completion,
+            span=span,
         )
+
+
+def _span_metadata(span) -> dict | None:
+    """Serving metadata exposed to clients (`dllama` field of the
+    non-stream response and the final SSE chunk): request id, TTFT,
+    queue wait, lane, reused prefix."""
+    if span is None or span is NULL_SPAN:
+        return None
+    return {
+        "request_id": span.request_id,
+        "lane": span.lane,
+        "ttft_ms": None if span.ttft_ms is None else round(span.ttft_ms, 3),
+        "queue_ms": (
+            None if span.queue_wait_ms is None
+            else round(span.queue_wait_ms, 3)
+        ),
+        "reused_prefix_tokens": span.reused_prefix_tokens,
+    }
 
 
 def _completion_response(
@@ -562,10 +766,11 @@ def _completion_response(
     finish_reason: str,
     n_prompt: int,
     n_completion: int,
+    span=None,
 ) -> dict:
     """The chat.completion response body, shared by the serialized and
     lane-scheduled serving paths."""
-    return {
+    resp = {
         "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
         "object": "chat.completion",
         "created": int(time.time()),
@@ -583,6 +788,10 @@ def _completion_response(
             "total_tokens": n_prompt + n_completion,
         },
     }
+    meta = _span_metadata(span)
+    if meta is not None:
+        resp["dllama"] = meta
+    return resp
 
 
 def _sse_write(wfile, data: str) -> None:
@@ -592,23 +801,50 @@ def _sse_write(wfile, data: str) -> None:
 
 
 def _chunk_payload(
-    state: ApiState, delta: str | None, stop: bool, reason: str = "stop"
+    state: ApiState,
+    delta: str | None,
+    stop: bool,
+    reason: str = "stop",
+    span=None,
 ) -> dict:
     choice: dict = {"index": 0, "finish_reason": reason if stop else None}
     if not stop:
         choice["delta"] = {"role": "assistant", "content": delta}
-    return {
+    payload = {
         "id": "cmpl-1",
         "object": "chat.completion.chunk",
         "created": int(time.time()),
         "model": state.model_name,
         "choices": [choice],
     }
+    if stop:
+        meta = _span_metadata(span)
+        if meta is not None:
+            payload["dllama"] = meta
+    return payload
+
+
+_KNOWN_PATHS = frozenset(
+    {
+        "/v1/chat/completions",
+        "/v1/models",
+        "/v1/health",
+        "/metrics",
+        "/health",
+        "/healthz",
+    }
+)
 
 
 def make_handler(state: ApiState):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+
+        def _count_request(self) -> None:
+            # unknown paths fold into one label so a scanner can't blow up
+            # the metric's cardinality
+            path = self.path if self.path in _KNOWN_PATHS else "other"
+            state.m_http.labels(path=path).inc()
 
         def log_message(self, fmt, *args):  # quiet access log
             pass
@@ -634,6 +870,7 @@ def make_handler(state: ApiState):
             self.end_headers()
 
         def do_GET(self):
+            self._count_request()
             if self.path == "/v1/models":
                 self._json(
                     {
@@ -648,12 +885,43 @@ def make_handler(state: ApiState):
                         ],
                     }
                 )
+            elif self.path == "/metrics":
+                body = state.obs.render().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", state.obs.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/v1/health":
+                sched = state.scheduler
+                total = state.engine.batch_size if sched is not None else 1
+                if sched is not None:
+                    active = sum(1 for ls in sched.lanes if ls is not None)
+                    queued = len(sched.pending)
+                else:
+                    active = 1 if state.lock.locked() else 0
+                    queued = 0
+                self._json(
+                    {
+                        "status": "ok",
+                        "model": state.model_name,
+                        "uptime_s": round(time.time() - state.start_unix, 3),
+                        "lanes": {
+                            "total": total,
+                            "active": active,
+                            "free": total - active,
+                        },
+                        "queue_depth": queued,
+                        "cache_epoch": state.engine.cache_epoch,
+                    }
+                )
             elif self.path in ("/health", "/healthz"):
                 self._json({"status": "ok"})
             else:
                 self.send_error(404, "Not Found")
 
         def do_POST(self):
+            self._count_request()
             if self.path != "/v1/chat/completions":
                 self.send_error(404, "Not Found")
                 return
@@ -668,19 +936,29 @@ def make_handler(state: ApiState):
             if state.scheduler is not None:
                 self._complete_lanes(params)
                 return
+            span = state.tracer.span(path="single")
             with state.lock:
-                if params.stream:
-                    self._stream(params)
-                else:
-                    try:
-                        response = state.complete(params, emit=lambda d: None)
-                    except ValueError as e:  # client-caused (e.g. prompt too long)
-                        self._json({"error": {"message": str(e)}}, 400)
-                        return
-                    except Exception as e:  # surface model errors as JSON
-                        self._json({"error": {"message": str(e)}}, 500)
-                        return
-                    self._json(response)
+                # queue wait on this path is the engine-lock wait
+                state.m_queue_wait.observe(span.mark_admitted())
+                state.m_admissions.inc()
+                state.m_lanes_active.set(1)
+                try:
+                    if params.stream:
+                        self._stream(params, span)
+                    else:
+                        try:
+                            response = state.complete(
+                                params, emit=lambda d: None, span=span
+                            )
+                        except ValueError as e:  # client-caused (e.g. prompt too long)
+                            self._json({"error": {"message": str(e)}}, 400)
+                            return
+                        except Exception as e:  # surface model errors as JSON
+                            self._json({"error": {"message": str(e)}}, 500)
+                            return
+                        self._json(response)
+                finally:
+                    state.m_lanes_active.set(0)
 
         def _complete_lanes(self, params: InferenceParams) -> None:
             """Concurrent path: submit to the lane scheduler and relay its
@@ -714,7 +992,9 @@ def make_handler(state: ApiState):
                             finish_reason = payload
                             break
                     if not errored:
-                        final = _chunk_payload(state, None, True, finish_reason)
+                        final = _chunk_payload(
+                            state, None, True, finish_reason, span=job.span
+                        )
                         _sse_write(
                             self.wfile,
                             "data: " + json.dumps(final) + "\r\n\r\n",
@@ -742,6 +1022,7 @@ def make_handler(state: ApiState):
                 finish_reason,
                 job.n_prompt_tokens,
                 job.n_completion,
+                span=job.span,
             )
             self._json(response)
 
@@ -752,7 +1033,7 @@ def make_handler(state: ApiState):
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
 
-        def _stream(self, params: InferenceParams) -> None:
+        def _stream(self, params: InferenceParams, span=None) -> None:
             self._sse_headers()
 
             def write_chunk(data: str) -> None:
@@ -764,8 +1045,13 @@ def make_handler(state: ApiState):
 
             finish_reason = "stop"
             try:
-                result = state.complete(params, emit=emit)
+                result = state.complete(params, emit=emit, span=span)
                 finish_reason = result["choices"][0]["finish_reason"]
+            except OSError:
+                # the client disconnected mid-stream (emit hit its dead
+                # socket); complete() already recorded the cancellation —
+                # nothing left to write to
+                return
             except Exception as e:
                 # headers are already sent; deliver the error in-stream so
                 # the client still gets a well-formed SSE termination
@@ -774,7 +1060,9 @@ def make_handler(state: ApiState):
                 )
             write_chunk(
                 "data: "
-                + json.dumps(_chunk_payload(state, None, True, finish_reason))
+                + json.dumps(
+                    _chunk_payload(state, None, True, finish_reason, span=span)
+                )
                 + "\r\n\r\n"
             )
             write_chunk("data: [DONE]\r\n\r\n")
@@ -816,9 +1104,17 @@ def serve(
     port: int = 9990,
     model_name: str = "dllama-tpu",
     chat_template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
+    trace_out: str | None = None,
 ):
-    state = ApiState(engine, tokenizer, model_name, chat_template_type)
+    state = ApiState(
+        engine,
+        tokenizer,
+        model_name,
+        chat_template_type,
+        tracer=Tracer(sink_path=trace_out) if trace_out else None,
+    )
     server = ThreadingHTTPServer((host, port), make_handler(state))
+    server.state = state  # tests and callers reach the tracer/registry here
     if host in ("0.0.0.0", "127.0.0.1"):
         print(f"Server URL: http://localhost:{port}/v1/")
     return server  # caller runs serve_forever() (tests drive it in a thread)
@@ -835,7 +1131,7 @@ def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="dllama-tpu-api")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=9990)
-    add_engine_args(parser)
+    add_engine_args(parser)  # includes --trace-out (the JSONL sink)
     args = parser.parse_args(argv)
 
     from ..parallel.mesh import enable_compilation_cache, reassert_platform
@@ -866,6 +1162,7 @@ def main(argv=None) -> None:
                 port=args.port,
                 model_name=os.path.basename(args.model),
                 chat_template_type=ttype,
+                trace_out=args.trace_out,
             )
             server.serve_forever()
             return

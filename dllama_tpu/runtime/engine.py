@@ -36,6 +36,14 @@ from .sampler import Sampler
 DEFAULT_PREFILL_BUCKETS = (1, 8, 32, 128, 512)
 
 
+def _sds(x):
+    """ShapeDtypeStruct (with sharding) of one live array — the lowering
+    spec the AOT pre-compiles consume."""
+    return jax.ShapeDtypeStruct(
+        x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+    )
+
+
 def _topp_mask(probs, topp):
     """Top-p nucleus mask on device, [B, V] probs -> masked probs; `topp`
     is a scalar or a per-lane [B] vector.
@@ -165,6 +173,48 @@ class InferenceEngine:
         buffer_float_type: str = "f32",
         moe_decode_dedup: bool | str = "auto",
     ):
+        # observability hooks (obs/metrics.py): every handle below is a
+        # no-op when the registry is disabled, so the decode path carries
+        # one attribute read of overhead in that state. Created before the
+        # first _fresh_cache() call (which bumps the epoch counter).
+        from ..obs.metrics import (
+            DEFAULT_TOKEN_BUCKETS_S,
+            get_registry,
+        )
+
+        self.obs = get_registry()
+        self._m_step = self.obs.histogram(
+            "dllama_engine_step_seconds",
+            "Wall time of one engine dispatch (compiled program call + "
+            "host readback), by step kind.",
+            labelnames=("kind",),
+        )
+        self._m_compiles = self.obs.counter(
+            "dllama_engine_compiles_total",
+            "Compiled-program builds by origin: dispatch = synchronous "
+            "compile on the serving path, prefetch = background window "
+            "pre-compile, prefetch-failed = a broken prefetch (boundary "
+            "will stall on a synchronous compile).",
+            labelnames=("origin",),
+        )
+        self._m_window_crossings = self.obs.counter(
+            "dllama_engine_window_crossings_total",
+            "Attention-window boundary crossings (a larger compiled "
+            "window took over mid-generation).",
+        )
+        self._m_epochs = self.obs.counter(
+            "dllama_engine_cache_epochs_total",
+            "KV-cache rebuilds (engine init, reset, or crash-consistency "
+            "recovery after a failed donated dispatch).",
+        )
+        self._m_tpot = self.obs.histogram(
+            "dllama_engine_block_token_seconds",
+            "Per-token share of a block decode dispatch (dispatch wall "
+            "time / tokens in the block).",
+            buckets=DEFAULT_TOKEN_BUCKETS_S,
+        )
+        self._obs_last_window = None
+
         self.reader = ModelReader(model_path, max_seq_len=max_seq_len)
         self.header: LlmHeader = self.reader.header
         self.tokenizer = tokenizer
@@ -317,6 +367,14 @@ class InferenceEngine:
         }
         self.cache = self._fresh_cache()
         self._token_sharding = NamedSharding(self.mesh, P("dp", None))
+        # AOT lowering specs are SNAPSHOTTED once here (r5 advisor item):
+        # params never change after init and every fresh cache has the
+        # same shapes/dtypes/shardings, so the prefetch thread lowers
+        # against this frozen tree instead of reading `self.cache` live —
+        # the live tree's buffers may be donated (deleted) mid-read by a
+        # concurrent dispatch on the serving thread.
+        self._param_specs = jax.tree.map(_sds, self.params)
+        self._cache_specs = jax.tree.map(_sds, self.cache)
         self._compiled = {}
         self._base_key = jax.random.PRNGKey(seed)
         self._lane_seed_base = seed
@@ -403,6 +461,7 @@ class InferenceEngine:
         # (api_server clears its prompt cache iff this moved — a
         # ValueError raised inside a guarded dispatch also rebuilds)
         self.cache_epoch = getattr(self, "cache_epoch", -1) + 1
+        self._m_epochs.inc()
         cache = init_kv_cache(
             self.header,
             self.batch_size,
@@ -478,6 +537,17 @@ class InferenceEngine:
         # compilation cache across runs).
         return min(w, s)
 
+    def _note_window(self, window: int) -> None:
+        """Count attention-window growth (each crossing compiles — or
+        prefetched — a fresh program; the counter makes the p99 stall
+        source visible on `/metrics`)."""
+        if (
+            self._obs_last_window is not None
+            and window > self._obs_last_window
+        ):
+            self._m_window_crossings.inc()
+        self._obs_last_window = window
+
     def _step_fn(self, t: int, greedy: bool, window: int = 0):
         """Build/jit the forward step for chunk length `t`."""
         key = (t, greedy, window)
@@ -508,17 +578,15 @@ class InferenceEngine:
             return last, cache
 
         self._compiled[key] = step
+        self._m_compiles.labels(origin="dispatch").inc()
         return step
 
     def _block_arg_specs(self, n_steps: int):
         """ShapeDtypeStructs (with shardings) matching a decode_block
-        dispatch exactly — what the AOT pre-compile lowers against."""
-
-        def sds(x):
-            return jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
-            )
-
+        dispatch exactly — what the AOT pre-compile lowers against. Uses
+        the init-time snapshot (`_param_specs`/`_cache_specs`): reading
+        `self.cache` here would race the serving thread's donated
+        dispatches (a donated buffer deletes mid-read)."""
         tok = jax.ShapeDtypeStruct(
             (self.batch_size, 1), jnp.int32, sharding=self._token_sharding
         )
@@ -530,9 +598,9 @@ class InferenceEngine:
         key = jax.random.fold_in(self._base_key, 0)
         rng = jax.ShapeDtypeStruct(key.shape, key.dtype)
         return (
-            jax.tree.map(sds, self.params),
+            self._param_specs,
             tok,
-            jax.tree.map(sds, self.cache),
+            self._cache_specs,
             scalar_i,
             rng,
             scalar_f,
@@ -604,6 +672,7 @@ class InferenceEngine:
         with self._compile_lock:
             self._compiled[key] = block
             self._compile_origin[key] = origin
+        self._m_compiles.labels(origin=origin).inc()
         return block
 
     def _prefetch(self, key, builder) -> None:
@@ -639,6 +708,7 @@ class InferenceEngine:
                 )
                 with self._compile_lock:
                     self._compile_origin[key] = "prefetch-failed"
+                self._m_compiles.labels(origin="prefetch-failed").inc()
             finally:
                 with self._compile_lock:
                     self._inflight.pop(key, None)
@@ -677,6 +747,7 @@ class InferenceEngine:
         arr = jax.device_put(arr, self._token_sharding)
         greedy = self.temperature == 0.0
         window = self._attn_window(pos + n_steps)
+        self._note_window(window)
         block = self._decode_block_fn(n_steps, greedy, window)
         if (
             self._aot_blocks
@@ -693,6 +764,7 @@ class InferenceEngine:
         rng = jax.random.fold_in(
             jax.random.fold_in(self._base_key, pos), self._rng_calls
         )
+        t0 = time.perf_counter()
         with self._cache_guard():
             out, self.cache = block(
                 self.params,
@@ -704,6 +776,9 @@ class InferenceEngine:
                 jnp.float32(self.sampler.topp),
             )
             out = np.asarray(out)  # [n_steps, lanes]
+        dt = time.perf_counter() - t0
+        self._m_step.labels(kind="decode_block").observe(dt)
+        self._m_tpot.observe(dt / n_steps)
         if per_lane:
             return [[int(t) for t in row] for row in out]
         return [int(t) for t in out[:, 0]]
@@ -738,6 +813,7 @@ class InferenceEngine:
             return jnp.sum(nll[0]), cache
 
         self._compiled[key] = score
+        self._m_compiles.labels(origin="dispatch").inc()
         return score
 
     def perplexity(self, tokens: list[int]) -> tuple[float, float, int]:
@@ -840,6 +916,7 @@ class InferenceEngine:
             return cache
 
         self._compiled[key] = step
+        self._m_compiles.labels(origin="dispatch").inc()
         return step
 
     def prefill_lane(self, lane: int, tokens: list[int], pos0: int = 0) -> None:
@@ -863,6 +940,7 @@ class InferenceEngine:
             )
         fills = tokens[:-1]
         p = pos0
+        t0 = time.perf_counter()
         while fills:
             bucket = self._bucket_for(len(fills), p)
             width = min(bucket, len(fills))
@@ -882,25 +960,24 @@ class InferenceEngine:
             with self._cache_guard():
                 self.cache = step(self.params, arr, self.cache, pos_arr)
             p += width
+        if p > pos0:
+            self._m_step.labels(kind="prefill_lane").observe(
+                time.perf_counter() - t0
+            )
 
     def _lane_arg_specs(self, n_steps: int):
         """Arg specs for a decode_lanes dispatch (the AOT pre-compile's
         lowering input); per-lane vectors stay unsharded like the
-        scalars in _block_arg_specs."""
-
-        def sds(x):
-            return jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
-            )
-
+        scalars in _block_arg_specs, and the params/cache trees come from
+        the init-time snapshot for the same no-donated-reads reason."""
         b = self.batch_size
         tok = jax.ShapeDtypeStruct(
             (b, 1), jnp.int32, sharding=self._token_sharding
         )
         return (
-            jax.tree.map(sds, self.params),
+            self._param_specs,
             tok,
-            jax.tree.map(sds, self.cache),
+            self._cache_specs,
             jax.ShapeDtypeStruct((b,), jnp.int32),
             jax.ShapeDtypeStruct((b,), jnp.bool_),
             jax.ShapeDtypeStruct((b,), jnp.int32),  # per-lane seeds
@@ -981,6 +1058,7 @@ class InferenceEngine:
         with self._compile_lock:
             self._compiled[key] = block
             self._compile_origin[key] = origin
+        self._m_compiles.labels(origin=origin).inc()
         return block
 
     def decode_lanes(
@@ -1030,6 +1108,7 @@ class InferenceEngine:
         act_arr = jnp.asarray(active, jnp.bool_)
         deepest = max(pos[i] for i in live)
         window = self._attn_window(deepest + n_steps)
+        self._note_window(window)
         block = self._lane_decode_fn(n_steps, window)
         if (
             self._aot_blocks
@@ -1053,6 +1132,7 @@ class InferenceEngine:
              ) & 0x7FFFFFFF
             for i, s in enumerate(seeds or [None] * self.batch_size)
         ]
+        t0 = time.perf_counter()
         with self._cache_guard():
             out, self.cache = block(
                 self.params,
@@ -1064,7 +1144,12 @@ class InferenceEngine:
                 jnp.asarray(temperature, jnp.float32),
                 jnp.asarray(topp, jnp.float32),
             )
-        return [[int(t) for t in row] for row in np.asarray(out)]
+            out_np = np.asarray(out)
+        dt = time.perf_counter() - t0
+        self._m_step.labels(kind="decode_lanes").observe(dt)
+        # each active stream advances one token per block row
+        self._m_tpot.observe(dt / n_steps)
+        return [[int(t) for t in row] for row in out_np]
 
     def _bucket_for(self, n: int, pos: int) -> int:
         """Smallest bucket covering n tokens whose PADDED extent still fits

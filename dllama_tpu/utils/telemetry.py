@@ -224,15 +224,39 @@ def profile(log_dir: str | None):
 
 
 class Counter:
-    """Tiny run-length metric accumulator for the serving surface."""
+    """Tiny run-length metric accumulator for the serving surface.
 
-    def __init__(self):
+    Migrated onto the obs registry (obs/metrics.py): each named Counter
+    doubles its (n, total_ms) into `dllama_<name>_events_total` /
+    `dllama_<name>_ms_total` so CLI-side token accounting shows up on a
+    server's ``GET /metrics`` scrape. The local ``n``/``total_ms``/``rate``
+    surface is unchanged (and is what the printers read) — the registry
+    copies are the exported view."""
+
+    def __init__(self, name: str = ""):
         self.n = 0
         self.total_ms = 0.0
+        self._m_events = self._m_ms = None
+        if name:
+            from ..obs.metrics import get_registry
+
+            reg = get_registry()
+            self._m_events = reg.counter(
+                f"dllama_{name}_events_total",
+                f"Events accumulated by the {name!r} telemetry counter.",
+            )
+            self._m_ms = reg.counter(
+                f"dllama_{name}_ms_total",
+                f"Milliseconds accumulated by the {name!r} telemetry "
+                "counter.",
+            )
 
     def add(self, ms: float, n: int = 1) -> None:
         self.n += n
         self.total_ms += ms
+        if self._m_events is not None:
+            self._m_events.inc(n)
+            self._m_ms.inc(ms)
 
     @property
     def rate(self) -> float:

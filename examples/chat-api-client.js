@@ -4,9 +4,22 @@
 // Start the server:
 //   python -m dllama_tpu.runtime.api_server --model m.m --tokenizer t.t --port 9990
 // Then:  node chat-api-client.js
+//
+// Responses carry a `dllama` metadata object (request_id, lane, ttft_ms,
+// queue_ms, reused_prefix_tokens) — on the non-stream response body, and
+// on the FINAL chunk of an SSE stream. The request_id matches the
+// server's --trace-out JSONL records, so a slow request spotted here can
+// be looked up in the trace.
 
 const HOST = process.env.DLLAMA_HOST || 'localhost';
 const PORT = process.env.DLLAMA_PORT || 9990;
+
+function printMeta(meta) {
+    if (!meta) return; // older server without the obs subsystem
+    console.log(
+        `   [${meta.request_id}] ttft=${meta.ttft_ms}ms ` +
+        `queue=${meta.queue_ms}ms reused_prefix=${meta.reused_prefix_tokens}`);
+}
 
 async function chat(messages, stream = false) {
     const response = await fetch(`http://${HOST}:${PORT}/v1/chat/completions`, {
@@ -21,6 +34,7 @@ async function chat(messages, stream = false) {
     });
     if (!stream) {
         const data = await response.json();
+        printMeta(data.dllama);
         return data.choices[0].message.content;
     }
     const reader = response.body.getReader();
@@ -37,9 +51,14 @@ async function chat(messages, stream = false) {
                 process.stdout.write(delta.content);
                 text += delta.content;
             }
+            // the final chunk (the one carrying finish_reason) also
+            // carries the request's timing metadata
+            if (chunk.choices[0].finish_reason) {
+                process.stdout.write('\n');
+                printMeta(chunk.dllama);
+            }
         }
     }
-    process.stdout.write('\n');
     return text;
 }
 

@@ -1,7 +1,11 @@
 """API server tests: OpenAI-compatible surface over a tiny model."""
 
 import json
+import re
+import socket
+import struct
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -376,23 +380,23 @@ def test_api_main_chat_template_flag(tmp_path):
         proc.wait(timeout=10)
 
 
-def test_lane_server_seed_warning(lane_server):
-    """A `seed` under the lane scheduler cannot be honored (shared
-    on-device RNG across lanes); the response must SAY so instead of
-    silently returning non-reproducible output (ADVICE r2 #3)."""
-    with _post(lane_server, {
-        "messages": [{"role": "user", "content": "hi"}],
-        "max_tokens": 4, "temperature": 0, "seed": 42,
-    }) as r:
-        body = json.loads(r.read())
-    assert "warning" in body and "seed" in body["warning"], body
-    # no seed -> no warning
-    with _post(lane_server, {
-        "messages": [{"role": "user", "content": "hi"}],
-        "max_tokens": 4, "temperature": 0,
-    }) as r:
+def test_lane_server_seed_reproducible(lane_server):
+    """A `seed` under the lane scheduler IS honored per lane (r5:
+    decode_lanes derives each lane's sampling keys from its own seed and
+    absolute positions): a seeded sampled request reproduces through the
+    concurrent path, and the response no longer carries the old
+    best-effort warning."""
+    payload = {
+        "messages": [{"role": "user", "content": "tell me"}],
+        "max_tokens": 6, "temperature": 0.9, "seed": 42,
+    }
+    with _post(lane_server, payload) as r:
         body = json.loads(r.read())
     assert "warning" not in body, body
+    a = body["choices"][0]["message"]["content"]
+    with _post(lane_server, payload) as r:
+        b = json.loads(r.read())["choices"][0]["message"]["content"]
+    assert a == b
 
 
 def test_chat_completion_q40_fused_engine(tmp_path):
@@ -527,3 +531,300 @@ def test_chat_completion_q40i8_kv8_engine(tmp_path):
         assert one == two and isinstance(one, str)
     finally:
         srv.shutdown()
+
+
+# -- observability (obs/): /metrics, /v1/health, --trace-out ----------------
+#
+# These tests own their server (unlike the URL-only fixtures above) so they
+# can reach `srv.state` — the metric handles, the tracer ring, and the lane
+# scheduler. The metrics registry is process-global, so every assertion on
+# a counter is a DELTA against a before-value, never an absolute count.
+
+
+@pytest.fixture(scope="module")
+def obs_server(tmp_path_factory):
+    """batch_size-3 engine + --trace-out sink; yields the HTTPServer."""
+    d = tmp_path_factory.mktemp("api_obs")
+    mp, tp_ = str(d / "m.m"), str(d / "t.t")
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=288, seq_len=384)
+    make_tiny_model(mp, weight_type=FloatType.Q40, cfg=cfg)
+    make_tiny_tokenizer(tp_, chat_template="<|start_header_id|>")
+    tok = Tokenizer(tp_)
+    engine = InferenceEngine(
+        mp, tokenizer=tok, tp=1, dtype=jnp.float32, temperature=0.0, seed=3,
+        batch_size=3,
+    )
+    trace_path = str(d / "trace.jsonl")
+    srv = serve(engine, tok, host="127.0.0.1", port=0, trace_out=trace_path)
+    srv.trace_path = trace_path
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv
+    srv.shutdown()
+
+
+def _url(srv):
+    return f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _scrape(srv):
+    with urllib.request.urlopen(_url(srv) + "/metrics", timeout=30) as r:
+        return r.headers["Content-Type"], r.read().decode()
+
+
+def _sample(text, name):
+    m = re.search(rf"^{re.escape(name)} ([0-9.e+-]+)$", text, re.M)
+    assert m, f"{name} not in scrape"
+    return float(m.group(1))
+
+
+def test_metrics_under_concurrent_streams(obs_server):
+    """The acceptance scrape: >=3 concurrent streaming requests against a
+    batch_size>1 engine, then GET /metrics serves Prometheus text with
+    non-empty TTFT/TPOT histograms, queue-wait, lane gauges, and the
+    NaiveCache hit/miss counters."""
+    state = obs_server.state
+    b_ttft, b_adm = state.m_ttft.count, state.m_admissions.value
+    b_qw, b_fin = state.m_queue_wait.count, state.m_finished.child_values()
+    prompts = ["alpha", "beta stream", "gamma ray"]
+    results, errors = [None] * 3, []
+
+    def worker(i):
+        try:
+            with _post(_url(obs_server), {
+                "messages": [{"role": "user", "content": prompts[i]}],
+                "max_tokens": 8, "temperature": 0, "stream": True,
+            }) as r:
+                results[i] = r.read().decode()
+        except Exception as e:  # pragma: no cover
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    for raw in results:
+        assert raw.rstrip().endswith("data: [DONE]")
+    # the final SSE chunk carries the span-derived request metadata
+    events = [json.loads(line[len("data: "):])
+              for line in results[0].splitlines()
+              if line.startswith("data: ") and line != "data: [DONE]"]
+    meta = events[-1]["dllama"]
+    assert meta["request_id"].startswith("req-")
+    assert meta["lane"] is not None and meta["ttft_ms"] > 0
+
+    # every request got admitted, waited in queue, and marked a TTFT
+    assert state.m_ttft.count >= b_ttft + 3
+    assert state.m_queue_wait.count >= b_qw + 3
+    assert state.m_admissions.value >= b_adm + 3
+    fin = state.m_finished.child_values()
+    assert sum(fin.values()) >= sum(b_fin.values()) + 3
+
+    ctype, text = _scrape(obs_server)
+    assert ctype == state.obs.CONTENT_TYPE
+    for fam in (
+        "dllama_ttft_seconds", "dllama_tpot_seconds",
+        "dllama_queue_wait_seconds", "dllama_prefill_seconds",
+        "dllama_lanes_total", "dllama_lanes_active", "dllama_queue_depth",
+        "dllama_prefix_cache_hits_total", "dllama_prefix_cache_misses_total",
+        "dllama_requests_finished_total", "dllama_http_requests_total",
+        "dllama_engine_step_seconds", "dllama_engine_compiles_total",
+    ):
+        assert f"# TYPE {fam} " in text, fam
+    m = re.search(r"^dllama_ttft_seconds_count (\d+)$", text, re.M)
+    assert m and int(m.group(1)) >= 3
+    m = re.search(r"^dllama_tpot_seconds_count (\d+)$", text, re.M)
+    assert m and int(m.group(1)) >= 1
+    assert _sample(text, "dllama_lanes_total") == 3
+    # cumulative buckets: the +Inf bucket equals the count
+    inf = re.search(r'^dllama_ttft_seconds_bucket\{le="\+Inf"\} (\d+)$',
+                    text, re.M)
+    cnt = re.search(r"^dllama_ttft_seconds_count (\d+)$", text, re.M)
+    assert inf and cnt and inf.group(1) == cnt.group(1)
+
+
+def test_health_endpoint(obs_server):
+    with urllib.request.urlopen(_url(obs_server) + "/v1/health",
+                                timeout=30) as r:
+        data = json.loads(r.read())
+    assert data["status"] == "ok"
+    assert data["model"]
+    assert data["uptime_s"] >= 0
+    assert data["lanes"]["total"] == 3
+    assert data["lanes"]["active"] + data["lanes"]["free"] == 3
+    assert data["queue_depth"] >= 0
+    assert isinstance(data["cache_epoch"], int)
+
+
+def test_trace_out_roundtrip_completed(obs_server):
+    """A finished request's lifecycle lands in the --trace-out JSONL with
+    queue wait, prefill span, first-token time, token counts, and finish
+    reason — matched to the request by the response's request_id."""
+    from dllama_tpu.obs.trace import read_jsonl
+
+    with _post(_url(obs_server), {
+        "messages": [{"role": "user", "content": "trace me"}],
+        "max_tokens": 5, "temperature": 0,
+    }) as r:
+        body = json.loads(r.read())
+    rid = body["dllama"]["request_id"]
+    assert body["dllama"]["ttft_ms"] > 0
+
+    rec = None
+    deadline = time.time() + 60
+    while rec is None and time.time() < deadline:
+        recs = [x for x in read_jsonl(obs_server.trace_path)
+                if x["request_id"] == rid]
+        rec = recs[0] if recs else None
+        if rec is None:
+            time.sleep(0.1)
+    assert rec is not None, "trace record never hit the sink"
+    assert rec["path"] == "lanes" and rec["finish_reason"] in ("stop", "length")
+    assert rec["cancelled"] is False
+    assert rec["queue_wait_s"] >= 0 and rec["prefill_s"] > 0
+    assert rec["ttft_s"] >= rec["queue_wait_s"]
+    assert rec["n_prompt_tokens"] > 0
+    assert 1 <= rec["n_completion"] <= 5
+    assert rec["total_s"] >= rec["ttft_s"]
+    # the in-memory ring holds the same record
+    assert any(x["request_id"] == rid
+               for x in obs_server.state.tracer.records())
+
+
+def test_trace_cancelled_stream(obs_server):
+    """A client that disconnects mid-stream produces a `cancelled` trace
+    record and bumps the SSE-cancellation counter: raw socket, read until
+    the first delta, then RST-close."""
+    state = obs_server.state
+    b_cancel = state.m_cancellations.value
+    b_recs = sum(1 for x in state.tracer.records()
+                 if x["finish_reason"] == "cancelled")
+    payload = json.dumps({
+        "messages": [{"role": "user", "content": "stream then vanish"}],
+        "max_tokens": 300, "temperature": 0, "stream": True,
+    }).encode()
+    s = socket.create_connection(
+        ("127.0.0.1", obs_server.server_address[1]), timeout=120)
+    try:
+        s.sendall(b"POST /v1/chat/completions HTTP/1.1\r\n"
+                  b"Host: t\r\nContent-Type: application/json\r\n"
+                  b"Content-Length: " + str(len(payload)).encode()
+                  + b"\r\n\r\n" + payload)
+        buf = b""
+        while b"data:" not in buf:
+            chunk = s.recv(4096)
+            assert chunk, f"stream closed before first delta: {buf!r}"
+            buf += chunk
+        # RST on close so the server's next write fails immediately
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+    finally:
+        s.close()
+
+    rec = None
+    deadline = time.time() + 120
+    while rec is None and time.time() < deadline:
+        recs = [x for x in state.tracer.records()
+                if x["finish_reason"] == "cancelled"]
+        rec = recs[-1] if len(recs) > b_recs else None
+        if rec is None:
+            time.sleep(0.2)
+    assert rec is not None, "cancellation never reached the tracer"
+    assert rec["cancelled"] is True
+    assert rec["n_completion"] >= 1  # it really was mid-stream
+    assert rec["queue_wait_s"] is not None and rec["ttft_s"] is not None
+    assert state.m_cancellations.value >= b_cancel + 1
+
+
+def test_lane_routing_eviction_and_prefix_trace(obs_server):
+    """Lane cache routing: a continuing conversation is routed back to
+    the lane holding its prefix (trace records the reused length), and a
+    fresh conversation arriving with all lane caches occupied evicts the
+    least-recently-used one (counted)."""
+    state = obs_server.state
+    sched = state.scheduler
+
+    # quiesce: scrub all lane caches so the routing below is deterministic
+    deadline = time.time() + 60
+    while any(ls is not None for ls in sched.lanes) or sched.pending:
+        assert time.time() < deadline, "lanes never drained"
+        time.sleep(0.05)
+    with sched.cv:
+        for c in sched.lane_cache:
+            c.clear()
+        for i in range(len(sched.lane_pending)):
+            sched.lane_pending[i] = None
+
+    def ask(messages):
+        with _post(_url(obs_server), {
+            "messages": messages, "max_tokens": 5, "temperature": 0,
+        }) as r:
+            return json.loads(r.read())
+
+    b_hits = state.m_prefix_hits.value
+    b_evic = state.m_evictions.value
+    convo_a = [{"role": "user", "content": "conversation A opener"}]
+    a1 = ask(convo_a)
+    ask([{"role": "user", "content": "conversation B opener"}])
+    # continue A: affinity routes it back to the prefix-holding lane
+    convo_a += [
+        {"role": "assistant", "content": a1["choices"][0]["message"]["content"]},
+        {"role": "user", "content": "continue"},
+    ]
+    a2 = ask(convo_a)
+    assert a2["dllama"]["reused_prefix_tokens"] > 0
+    assert state.m_prefix_hits.value == b_hits + 1
+    assert state.m_evictions.value == b_evic  # nothing evicted yet
+    rec = next(x for x in state.tracer.records()
+               if x["request_id"] == a2["dllama"]["request_id"])
+    assert rec["reused_prefix_tokens"] == a2["dllama"]["reused_prefix_tokens"]
+    assert rec["lane"] == a2["dllama"]["lane"]
+
+    # fill the third lane, then a fourth conversation must evict the LRU
+    # cache (conversation B's lane: A's was refreshed by the continuation)
+    ask([{"role": "user", "content": "conversation C opener"}])
+    assert state.m_evictions.value == b_evic
+    d1 = ask([{"role": "user", "content": "conversation D opener"}])
+    assert state.m_evictions.value == b_evic + 1
+    assert d1["dllama"]["reused_prefix_tokens"] == 0
+    # and B's conversation no longer matches anywhere: a B continuation
+    # prefills from scratch (miss, not hit)
+    b_misses = state.m_prefix_misses.value
+    ask([{"role": "user", "content": "conversation B opener"},
+         {"role": "assistant", "content": "x"},
+         {"role": "user", "content": "more"}])
+    assert state.m_prefix_misses.value == b_misses + 1
+
+
+def test_scheduler_error_counter(obs_server):
+    """An engine error inside the scheduler loop is counted (satellite:
+    the loop used to swallow these silently), the in-flight request gets
+    a 500, and the server keeps serving."""
+    state = obs_server.state
+    engine = state.engine
+    b_err = state.m_sched_errors.value
+    real = engine.decode_lanes
+
+    def boom(*a, **k):
+        raise RuntimeError("injected lane dispatch failure")
+
+    engine.decode_lanes = boom
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(_url(obs_server), {
+                "messages": [{"role": "user", "content": "doomed"}],
+                "max_tokens": 4, "temperature": 0,
+            }).read()
+        assert exc.value.code == 500
+        assert "injected" in json.loads(exc.value.read())["error"]["message"]
+    finally:
+        engine.decode_lanes = real
+    assert state.m_sched_errors.value == b_err + 1
+    # scheduler thread survived: the next request completes normally
+    with _post(_url(obs_server), {
+        "messages": [{"role": "user", "content": "still alive?"}],
+        "max_tokens": 4, "temperature": 0,
+    }) as r:
+        assert json.loads(r.read())["object"] == "chat.completion"

@@ -822,3 +822,75 @@ def test_lane_seed_reproducible_across_lane_mix(tiny_model):
     out3 = e.decode_lanes([5, 9], [0, 0], 12, temperature=[0.8, 0.7],
                           seeds=[43, None])
     assert [r[0] for r in out3] != lane0_a
+
+
+def test_aot_specs_use_init_snapshot(tiny_model):
+    """The AOT lowering specs are built from the init-time
+    ShapeDtypeStruct snapshot, never from the live trees: a prefetch
+    thread reads these specs while the serving thread's dispatch is
+    donating (deleting) the live cache buffers. Nulling the live trees
+    proves no such read happens."""
+    mp, _ = tiny_model
+    e = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    expect_cache = jax.tree.map(lambda x: (x.shape, str(x.dtype)), e.cache)
+    live_cache, live_params = e.cache, e.params
+    e.cache = None
+    e.params = None
+    try:
+        specs = e._block_arg_specs(8)
+    finally:
+        e.cache, e.params = live_cache, live_params
+    param_specs, tok, cache_specs = specs[0], specs[1], specs[2]
+    assert tok.shape == (e.batch_size, 1)
+    got_cache = jax.tree.map(lambda s: (s.shape, str(s.dtype)), cache_specs)
+    assert got_cache == expect_cache
+    assert jax.tree.structure(param_specs) == jax.tree.structure(live_params)
+    # and the specs really drive a compile: the engine still generates
+    out, _, _ = e.generate([1, 2, 3], max_steps=5)
+    assert len(out) > 0
+
+
+def test_lane_aot_specs_use_init_snapshot(tiny_model):
+    """decode_lanes' lowering specs come from the same snapshot (the lane
+    scheduler's prefetches race donated dispatches the same way)."""
+    mp, _ = tiny_model
+    e = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0,
+                        batch_size=2)
+    live_cache, live_params = e.cache, e.params
+    e.cache = None
+    e.params = None
+    try:
+        specs = e._lane_arg_specs(4)
+    finally:
+        e.cache, e.params = live_cache, live_params
+    assert specs[1].shape == (2, 1)  # token vector is per-lane
+    assert specs[3].shape == (2,)  # positions
+    rows = e.decode_lanes([1, 2], [0, 0], 4, active=[True, True])
+    assert len(rows) == 4 and all(len(r) == 2 for r in rows)
+
+
+def test_engine_obs_counters(tiny_model):
+    """Engine instrumentation: dispatch compiles and step latencies are
+    counted, and the window-crossing counter fires exactly on growth."""
+    mp, _ = tiny_model
+    e = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    disp = e._m_compiles.labels(origin="dispatch")
+    b_disp = disp.value
+    b_step = e._m_step.labels(kind="decode_block").count
+    b_tpot = e._m_tpot.count
+    out, _, _ = e.generate([1, 2, 3], max_steps=8)
+    assert len(out) > 0
+    assert disp.value > b_disp  # prefill and/or block programs compiled
+    assert e._m_step.labels(kind="decode_block").count > b_step
+    assert e._m_tpot.count > b_tpot
+
+    crossings = e._m_window_crossings
+    e._obs_last_window = None
+    b_w = crossings.value
+    e._note_window(32)
+    e._note_window(32)  # same window: no crossing
+    assert crossings.value == b_w
+    e._note_window(64)  # growth: one crossing
+    assert crossings.value == b_w + 1
+    e._note_window(32)  # shrink (fresh request): no crossing
+    assert crossings.value == b_w + 1

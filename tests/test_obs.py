@@ -1,0 +1,204 @@
+"""obs/ unit tests: metrics registry rendering, lifecycle spans, JSONL
+tracing, and the telemetry Counter migration. Pure-Python (no engine), so
+they ride the fast CI lane."""
+
+import json
+import threading
+
+import pytest
+
+from dllama_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS_S,
+    MetricsRegistry,
+)
+from dllama_tpu.obs.trace import NULL_SPAN, Tracer, read_jsonl
+
+pytestmark = pytest.mark.fast
+
+
+# -- metrics registry --------------------------------------------------------
+
+
+def test_counter_gauge_basic():
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests_total", "requests")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    g = reg.gauge("t_depth", "queue depth")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value == 4
+
+
+def test_registration_idempotent_and_type_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("t_x_total")
+    b = reg.counter("t_x_total")
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("t_x_total")
+
+
+def test_histogram_buckets_cumulative():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", "latency", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 2.0, 100.0):  # le is inclusive: 0.1 -> first
+        h.observe(v)
+    text = reg.render()
+    assert 't_lat_seconds_bucket{le="0.1"} 2' in text
+    assert 't_lat_seconds_bucket{le="1"} 3' in text
+    assert 't_lat_seconds_bucket{le="10"} 4' in text
+    assert 't_lat_seconds_bucket{le="+Inf"} 5' in text
+    assert "t_lat_seconds_count 5" in text
+    assert f"t_lat_seconds_sum {0.05 + 0.1 + 0.5 + 2.0 + 100.0}" in text
+
+
+def test_labeled_families_and_escaping():
+    reg = MetricsRegistry()
+    c = reg.counter("t_by_path_total", 'paths with "quotes"', labelnames=("path",))
+    c.labels(path="/v1/chat").inc()
+    c.labels(path='we"ird\npath').inc(2)
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")
+    with pytest.raises(ValueError):
+        c.inc()  # labeled family has no default child
+    text = reg.render()
+    assert '# HELP t_by_path_total paths with "quotes"' in text
+    assert 't_by_path_total{path="/v1/chat"} 1' in text
+    assert 't_by_path_total{path="we\\"ird\\npath"} 2' in text
+
+
+def test_render_prometheus_text_format_shape():
+    """Every family renders a HELP+TYPE header and every sample line is
+    `name{labels} value` — the subset of the 0.0.4 exposition format a
+    stock Prometheus scraper requires."""
+    reg = MetricsRegistry()
+    reg.counter("t_a_total", "a").inc()
+    reg.gauge("t_b", "b").set(1.5)
+    reg.histogram("t_c_seconds", "c").observe(0.2)
+    lines = reg.render().splitlines()
+    assert lines, "empty render"
+    names = set()
+    for line in lines:
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            names.add(line.split()[2])
+            continue
+        name, value = line.rsplit(" ", 1)
+        float(value)  # parses as a number
+        base = name.split("{")[0]
+        base = base.removesuffix("_bucket").removesuffix("_sum")
+        base = base.removesuffix("_count")
+        assert base in names, line  # samples follow their family header
+    assert len(DEFAULT_LATENCY_BUCKETS_S) + 1 == sum(
+        1 for line in lines if line.startswith("t_c_seconds_bucket")
+    )
+
+
+def test_disabled_registry_is_noop():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("t_n_total")
+    h = reg.histogram("t_n_seconds")
+    c.inc()
+    h.observe(1.0)
+    assert c.value == 0 and h.count == 0
+    reg.enable()
+    c.inc()
+    assert c.value == 1
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+    c = reg.counter("t_mt_total")
+    h = reg.histogram("t_mt_seconds", buckets=(1.0,))
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert h.count == 8000
+
+
+# -- spans + tracer ----------------------------------------------------------
+
+
+def test_span_lifecycle_and_record():
+    tr = Tracer(capacity=4)
+    span = tr.span(path="lanes")
+    qw = span.mark_admitted(lane=2, reused_prefix_tokens=7)
+    assert qw >= 0 and span.lane == 2
+    span.set_prefill_seconds(0.25)
+    ttft = span.mark_first_token()
+    assert ttft is not None and ttft >= qw
+    assert span.mark_first_token() is None  # single-shot
+    rec = span.finish("stop", n_prompt=11, n_completion=3)
+    assert span.finish("length") is None  # idempotent: first reason wins
+    assert rec["finish_reason"] == "stop" and rec["cancelled"] is False
+    assert rec["reused_prefix_tokens"] == 7
+    assert rec["n_prompt_tokens"] == 11 and rec["n_completion"] == 3
+    assert rec["prefill_s"] == 0.25
+    assert rec["queue_wait_s"] is not None and rec["ttft_s"] is not None
+    assert tr.records() == [rec]
+    assert span.ttft_ms == pytest.approx(rec["ttft_s"] * 1000)
+
+
+def test_span_cancelled_flag():
+    tr = Tracer()
+    span = tr.span()
+    span.mark_admitted()
+    rec = span.finish("cancelled", n_completion=2)
+    assert rec["cancelled"] is True
+    # TTFT never happened: recorded honestly as null
+    assert rec["ttft_s"] is None
+
+
+def test_null_span_is_inert():
+    tr_len_before = NULL_SPAN.finish("stop")
+    assert tr_len_before is None
+    assert NULL_SPAN.mark_admitted(lane=1) == 0.0
+    assert NULL_SPAN.mark_first_token() is None
+
+
+def test_tracer_ring_bound_and_jsonl(tmp_path):
+    sink = str(tmp_path / "trace.jsonl")
+    tr = Tracer(capacity=3, sink_path=sink)
+    for i in range(5):
+        span = tr.span(request_id=f"r{i}")
+        span.mark_admitted()
+        span.finish("stop", n_prompt=1, n_completion=i)
+    assert [r["request_id"] for r in tr.records()] == ["r2", "r3", "r4"]
+    tr.close()
+    # the sink kept ALL records (the ring only bounds memory)
+    recs = read_jsonl(sink)
+    assert [r["request_id"] for r in recs] == [f"r{i}" for i in range(5)]
+    assert all(json.dumps(r) for r in recs)  # each line round-trips
+    # export dumps the current ring
+    out = str(tmp_path / "export.jsonl")
+    assert tr.export(out) == 3
+    assert len(read_jsonl(out)) == 3
+
+
+def test_telemetry_counter_on_registry():
+    from dllama_tpu.obs.metrics import get_registry
+    from dllama_tpu.utils.telemetry import Counter
+
+    c = Counter("t_decode")
+    c.add(10.0, n=2)
+    c.add(5.0)
+    assert c.n == 3 and c.total_ms == 15.0
+    assert c.rate == pytest.approx(3 * 1000.0 / 15.0)
+    reg = get_registry()
+    assert reg.counter("dllama_t_decode_events_total").value == 3
+    assert reg.counter("dllama_t_decode_ms_total").value == 15.0
+    # anonymous counters keep the old purely-local behavior
+    anon = Counter()
+    anon.add(1.0)
+    assert anon.n == 1
