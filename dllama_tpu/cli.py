@@ -87,6 +87,10 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                         "generation (request id, queue wait, prefill "
                         "span, TTFT, token counts, finish reason) to "
                         "PATH (obs/trace.py)")
+    p.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                   help="write the engine flight recorder's event ring "
+                        "as a JSON postmortem into DIR when a step or "
+                        "the lane-scheduler loop raises (obs/recorder.py)")
     p.add_argument("--moe-decode-dedup", default="auto", nargs="?",
                    const="on",  # bare flag keeps its r4 meaning (force on)
                    choices=["auto", "on", "off"],
@@ -208,9 +212,28 @@ def load_engine(args):
     print(f"💡 WeightFormat: {engine.weight_format}")
     from .utils.telemetry import memory_report
 
-    memory_report(
+    mem = memory_report(
         engine.params, engine.cache, n_devices=tp * dp * sp * pp, tp=tp
-    ).print()
+    )
+    mem.print()
+    # startup roofline: the analytic HBM floor for decode next to the
+    # memory report — what "as fast as the hardware allows" means in
+    # ms/token for THIS model/format/layout (obs/cost.py)
+    from .obs.cost import print_roofline_report
+    from .obs.device import compare_with_analytic, sample_device_memory
+    from .obs.recorder import get_recorder
+
+    print_roofline_report(
+        h, engine.weight_format, tp=tp, pp=pp,
+        i8_group=engine.i8_group or 512,
+    )
+    # live per-chip memory vs the analytic figure: a >10% gap logs a
+    # warning (leak / unplanned replication / stale analytic model)
+    compare_with_analytic(
+        mem.per_device_bytes, sample_device_memory(engine.obs)
+    )
+    if getattr(args, "postmortem_dir", None):
+        get_recorder().postmortem_dir = args.postmortem_dir
     tok.print_header()
     return engine, tok
 

@@ -10,14 +10,35 @@ allows"; this package is how that claim gets numbers instead of vibes
 All hooks are no-ops when the registry is disabled (``DLLAMA_OBS=0`` or
 ``get_registry().disable()``); an enabled histogram observation is an O(1)
 bucket increment under a short lock.
+
+PR 4 adds the ENGINE-level substrate below the request metrics: the
+flight recorder (``recorder.py``, a bounded ring of structured engine
+events with postmortem dumps), device memory telemetry (``device.py``,
+``device.memory_stats()`` vs the analytic ``memory_report``), and
+compiled-step cost analysis (``cost.py``, XLA flops/bytes vs the HBM
+roofline) — all surfaced by the API server's ``/v1/debug/*`` endpoints.
 """
 
+from .cost import (
+    extract_cost,
+    hbm_peak_bytes_per_s,
+    print_roofline_report,
+    roofline_fraction,
+    roofline_report,
+    weight_bytes_per_token,
+)
+from .device import (
+    compare_with_analytic,
+    device_memory_stats,
+    sample_device_memory,
+)
 from .metrics import (
     DEFAULT_LATENCY_BUCKETS_S,
     DEFAULT_TOKEN_BUCKETS_S,
     MetricsRegistry,
     get_registry,
 )
+from .recorder import FlightRecorder, get_recorder
 from .trace import NULL_SPAN, RequestSpan, Tracer
 
 __all__ = [
@@ -25,6 +46,17 @@ __all__ = [
     "DEFAULT_TOKEN_BUCKETS_S",
     "MetricsRegistry",
     "get_registry",
+    "FlightRecorder",
+    "get_recorder",
+    "device_memory_stats",
+    "sample_device_memory",
+    "compare_with_analytic",
+    "extract_cost",
+    "hbm_peak_bytes_per_s",
+    "roofline_fraction",
+    "roofline_report",
+    "print_roofline_report",
+    "weight_bytes_per_token",
     "NULL_SPAN",
     "RequestSpan",
     "Tracer",

@@ -1,0 +1,159 @@
+"""Compiled-step cost analysis + HBM roofline accounting.
+
+Decode on this hardware is HBM-bandwidth-bound (docs/silicon_r03.md, the
+q40i4 format PR): a decode step's floor is (bytes it must read) / (HBM
+peak). XLA already knows the first number for every compiled program —
+``compiled.cost_analysis()`` reports flops and bytes accessed — so this
+module harvests it from the engine's compile cache, pairs it with the
+measured step-time histograms, and turns "is decode as fast as the
+hardware allows?" into a single achieved-vs-roofline fraction instead of
+a guess.
+
+The same analytic weight-read model the bench uses
+(``weight_bytes_per_token``) lives here so the CLI can print a startup
+roofline report next to the memory/ICI reports: bytes per decoded token
+per chip, the HBM floor in ms/token, and the implied tok/s ceiling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Approximate per-chip HBM peak bandwidth by TPU generation, bytes/s
+# (public chip specs; matched against jax.devices()[0].device_kind,
+# lowercase substring). Unknown kinds — and the CPU test backend — report
+# None, and every roofline figure downstream degrades to "unavailable"
+# rather than a made-up fraction.
+HBM_PEAK_BYTES_PER_S = {
+    "v6e": 1640e9,
+    "v6": 1640e9,
+    "v5p": 2765e9,
+    "v5e": 819e9,
+    "v5litepod": 819e9,
+    "v4": 1228e9,
+    "v3": 900e9,
+}
+
+
+def hbm_peak_bytes_per_s() -> float | None:
+    """Per-chip HBM peak for the current backend, or None when unknown
+    (CPU, unrecognized accelerator)."""
+    if jax.default_backend() != "tpu":
+        return None
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+    for marker, peak in HBM_PEAK_BYTES_PER_S.items():
+        if marker in kind:
+            return peak
+    return None
+
+
+def extract_cost(compiled) -> dict | None:
+    """{flops, bytes_accessed} from an executable's ``cost_analysis()``,
+    or None when the object is not an AOT-compiled executable (lazily
+    jitted step fns), the backend returns nothing, or the surface raises.
+    jax has returned both a bare dict and a one-per-module list across
+    versions; both shapes are accepted."""
+    fn = getattr(compiled, "cost_analysis", None)
+    if fn is None:
+        return None
+    try:
+        ca = fn()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = ca.get("flops")
+    bytes_accessed = ca.get("bytes accessed")
+    if flops is None and bytes_accessed is None:
+        return None
+    return {
+        "flops": float(flops or 0.0),
+        "bytes_accessed": float(bytes_accessed or 0.0),
+    }
+
+
+def roofline_fraction(
+    bytes_accessed: float, step_seconds: float, peak_bytes_per_s: float | None
+) -> float | None:
+    """Fraction of the HBM roofline a measured step achieved: achieved
+    bytes/s over peak. None when any input is missing/degenerate."""
+    if (
+        peak_bytes_per_s is None
+        or peak_bytes_per_s <= 0
+        or step_seconds <= 0
+        or bytes_accessed <= 0
+    ):
+        return None
+    return (bytes_accessed / step_seconds) / peak_bytes_per_s
+
+
+def weight_bytes_per_token(h, weight_format: str, i8_group: int = 512) -> int:
+    """HBM bytes of weights a single decode step must read: every matmul
+    weight once (MoE: attention weights + the active experts' share).
+    Q40 device layout = int8 values + f32 scale per 32 block = 1.125
+    B/weight; grouped int8 = 1 + 4/G; packed nibbles + f16 scales =
+    0.5625; dense bf16 = 2 B/weight. (Shared by bench.py and the startup
+    roofline report.)"""
+    bpw = {
+        "q40": 1.125,
+        "q40i8": 1.0 + 4.0 / i8_group,
+        "q40i4": 0.5 + 2.0 / 32.0,
+    }.get(weight_format, 2.0)
+    att = h.dim * h.q_dim + 2 * h.dim * h.kv_dim + h.q_dim * h.dim
+    ffn = 3 * h.dim * h.ff_dim
+    if h.n_experts:
+        ffn *= h.n_active_experts  # ragged kernel reads active experts only
+    total = (h.n_layers * (att + ffn) + h.dim * h.vocab_size) * bpw
+    if h.n_experts:
+        total += h.n_layers * h.dim * h.n_experts * 4  # f32 gate
+    return int(total)
+
+
+def roofline_report(
+    h, weight_format: str, tp: int = 1, pp: int = 1, i8_group: int = 512
+) -> dict:
+    """Analytic decode roofline for this model/format/layout: weight-read
+    bytes per token per chip (weights shard over tp x pp; dp/sp replicate
+    them, each replica reading its own copy) and, when the backend's HBM
+    peak is known, the ms/token floor + tok/s ceiling."""
+    shards = max(tp, 1) * max(pp, 1)
+    per_chip = weight_bytes_per_token(h, weight_format, i8_group) // shards
+    peak = hbm_peak_bytes_per_s()
+    rep = {
+        "weight_bytes_per_token_per_chip": per_chip,
+        "hbm_peak_bytes_per_s": peak,
+        "min_ms_per_token": None,
+        "max_tok_s_per_chip": None,
+    }
+    if peak:
+        rep["min_ms_per_token"] = per_chip / peak * 1000.0
+        rep["max_tok_s_per_chip"] = peak / per_chip if per_chip else None
+    return rep
+
+
+def print_roofline_report(
+    h, weight_format: str, tp: int = 1, pp: int = 1, i8_group: int = 512
+) -> dict:
+    """Startup roofline printout (rides next to the memory/ICI reports in
+    cli.load_engine); returns the report dict it printed."""
+    rep = roofline_report(h, weight_format, tp=tp, pp=pp, i8_group=i8_group)
+    gb = rep["weight_bytes_per_token_per_chip"] / 1e9
+    if rep["hbm_peak_bytes_per_s"]:
+        print(
+            f"📐 Roofline: {gb:.3f} GB weight reads/token/chip @ "
+            f"{rep['hbm_peak_bytes_per_s'] / 1e9:.0f} GB/s HBM peak -> "
+            f">= {rep['min_ms_per_token']:.2f} ms/token "
+            f"(<= {rep['max_tok_s_per_chip']:.1f} tok/s/chip)"
+        )
+    else:
+        print(
+            f"📐 Roofline: {gb:.3f} GB weight reads/token/chip "
+            f"(HBM peak unknown on the {jax.default_backend()!r} backend; "
+            "no tok/s ceiling)"
+        )
+    return rep

@@ -1,0 +1,139 @@
+"""Device memory telemetry: what HBM *actually* holds, per chip.
+
+`utils/telemetry.memory_report` is the ANALYTIC accounting (bytes the
+param/cache pytrees should occupy, divided by the sharding layout); this
+module reads the runtime's own ledger via ``device.memory_stats()`` so
+creeping allocations (a leaked donated buffer, an unexpected replication,
+compile scratch that never freed) show up as a divergence instead of an
+OOM three hours into a serving run.
+
+``memory_stats()`` is a PJRT-optional surface: TPU backends report
+``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``; the CPU
+backend (and older jaxlibs) return None or raise — both degrade here to
+an explicit ``available: false`` marker, never an exception, so the same
+code path serves the CPU test backend and silicon.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+from .metrics import get_registry
+
+logger = logging.getLogger(__name__)
+
+# analytic-vs-measured divergence beyond this fraction logs a warning
+DIVERGENCE_WARN_FRACTION = 0.10
+
+
+def device_memory_stats() -> list[dict]:
+    """Per-device memory snapshot; one entry per ``jax.devices()`` device.
+    Entries carry ``available: False`` when the backend has no stats
+    (CPU, or a PJRT plugin without the surface)."""
+    out = []
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        entry = {
+            "device": str(d),
+            "platform": getattr(d, "platform", "unknown"),
+            "available": stats is not None,
+        }
+        if stats is not None:
+            entry["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+            entry["peak_bytes_in_use"] = int(
+                stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+            )
+            entry["bytes_limit"] = int(stats.get("bytes_limit", 0))
+        out.append(entry)
+    return out
+
+
+def sample_device_memory(registry=None) -> list[dict]:
+    """Snapshot ``device_memory_stats()`` into registry gauges
+    (``dllama_device_bytes_in_use`` / ``_peak_bytes_in_use`` /
+    ``_bytes_limit``, labeled by device) and return the snapshot. On a
+    stats-less backend the gauges are simply never set."""
+    reg = registry if registry is not None else get_registry()
+    g_use = reg.gauge(
+        "dllama_device_bytes_in_use",
+        "Device (HBM) bytes currently allocated, per chip "
+        "(device.memory_stats; absent on backends without the surface).",
+        labelnames=("device",),
+    )
+    g_peak = reg.gauge(
+        "dllama_device_peak_bytes_in_use",
+        "High-water-mark of device bytes allocated, per chip.",
+        labelnames=("device",),
+    )
+    g_limit = reg.gauge(
+        "dllama_device_bytes_limit",
+        "Device memory capacity the runtime will allocate up to, per chip.",
+        labelnames=("device",),
+    )
+    stats = device_memory_stats()
+    for s in stats:
+        if not s["available"]:
+            continue
+        g_use.labels(device=s["device"]).set(s["bytes_in_use"])
+        g_peak.labels(device=s["device"]).set(s["peak_bytes_in_use"])
+        g_limit.labels(device=s["device"]).set(s["bytes_limit"])
+    return stats
+
+
+def compare_with_analytic(
+    analytic_per_chip_bytes: int,
+    stats: list[dict] | None = None,
+    warn_fraction: float = DIVERGENCE_WARN_FRACTION,
+) -> dict:
+    """Measured bytes-in-use per chip vs the analytic per-chip figure
+    from ``telemetry.memory_report``. Logs a warning past
+    ``warn_fraction`` (runtime holding meaningfully more than the model
+    accounts for = a leak or unplanned replication; meaningfully less =
+    the analytic model itself drifted). Returns a JSON-ready comparison
+    (``/v1/debug/memory`` embeds it)."""
+    if stats is None:
+        stats = device_memory_stats()
+    measured = [s for s in stats if s["available"]]
+    if not measured or analytic_per_chip_bytes <= 0:
+        return {
+            "available": False,
+            "analytic_per_chip_bytes": int(analytic_per_chip_bytes),
+            "max_divergence_fraction": None,
+            "per_chip": [],
+        }
+    per_chip = []
+    worst = 0.0
+    for s in measured:
+        div = (
+            s["bytes_in_use"] - analytic_per_chip_bytes
+        ) / analytic_per_chip_bytes
+        per_chip.append(
+            {
+                "device": s["device"],
+                "bytes_in_use": s["bytes_in_use"],
+                "divergence_fraction": round(div, 4),
+            }
+        )
+        if abs(div) > abs(worst):
+            worst = div
+    if abs(worst) > warn_fraction:
+        logger.warning(
+            "device memory diverges from the analytic report by %+.1f%% "
+            "(measured %d B vs analytic %d B per chip): a positive gap "
+            "suggests leaked/duplicated buffers or compile scratch, a "
+            "negative one a stale analytic model",
+            worst * 100.0,
+            max(s["bytes_in_use"] for s in measured),
+            analytic_per_chip_bytes,
+        )
+    return {
+        "available": True,
+        "analytic_per_chip_bytes": int(analytic_per_chip_bytes),
+        "max_divergence_fraction": round(worst, 4),
+        "per_chip": per_chip,
+    }

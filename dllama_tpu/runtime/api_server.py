@@ -32,7 +32,9 @@ import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..obs.device import compare_with_analytic, sample_device_memory
 from ..obs.metrics import DEFAULT_TOKEN_BUCKETS_S, get_registry
+from ..obs.recorder import get_recorder
 from ..obs.trace import NULL_SPAN, Tracer
 from ..tokenizer import (
     CHAT_TEMPLATE_NAMES,
@@ -238,6 +240,7 @@ class LaneScheduler:
                         # a fresh conversation takes a lane that still held
                         # another conversation's reusable prefix
                         self.state.m_evictions.inc()
+                        self.state.recorder.record("evict", lane=lane)
                     self._admission_count += 1
                     self.lane_used[lane] = self._admission_count
                     admissions.append((lane, job))
@@ -262,6 +265,17 @@ class LaneScheduler:
                         "in-flight lanes"
                     )
                     self.state.m_sched_errors.inc()
+                    self.state.recorder.record(
+                        "scheduler_error",
+                        error=str(e),
+                        error_type=type(e).__name__,
+                        n_lanes_dropped=sum(
+                            1 for ls in self.lanes if ls is not None
+                        ),
+                    )
+                    # black-box dump: the ring holds the dispatches that
+                    # led here (written only when a postmortem dir is set)
+                    self.state.recorder.postmortem("scheduler-loop", e)
                     for lane in range(len(self.lanes)):
                         if self.lanes[lane] is not None:
                             job = self.lanes[lane].job
@@ -360,6 +374,10 @@ class LaneScheduler:
                 prompt_end=prompt_end,
             )
             self._set_lane_gauge()
+            state.recorder.record(
+                "admit", lane=lane, reused_prefix_tokens=start_pos,
+                n_prompt=len(tokens),
+            )
         except Exception as e:
             job.events.put(("error", str(e)))
             if job.span.finish("error") is not None:
@@ -400,6 +418,10 @@ class LaneScheduler:
             if reason == "cancelled":
                 self.state.m_cancellations.inc()
         ls.job.events.put(("done", reason))
+        self.state.recorder.record(
+            "finish", lane=lane, reason=reason, pos=ls.pos,
+            n_completion=ls.job.n_completion,
+        )
         self.lanes[lane] = None
         self._set_lane_gauge()
         with self.cv:
@@ -483,7 +505,18 @@ class ApiState:
         # created up front (before the scheduler thread starts using them)
         # so the hot path never pays a registry lookup.
         self.obs = get_registry()
+        self.recorder = get_recorder()
         self.tracer = tracer if tracer is not None else Tracer()
+        # analytic per-chip accounting, computed once: /v1/debug/memory
+        # compares it against the live device.memory_stats() snapshot
+        from ..utils.telemetry import memory_report
+
+        self.mem_report = memory_report(
+            engine.params,
+            engine.cache,
+            n_devices=engine.mesh.devices.size,
+            tp=engine.tp,
+        )
         self.m_http = self.obs.counter(
             "dllama_http_requests_total",
             "HTTP requests by path (unknown paths fold into 'other').",
@@ -829,6 +862,9 @@ _KNOWN_PATHS = frozenset(
         "/v1/chat/completions",
         "/v1/models",
         "/v1/health",
+        "/v1/debug/recorder",
+        "/v1/debug/memory",
+        "/v1/debug/compile",
         "/metrics",
         "/health",
         "/healthz",
@@ -886,6 +922,9 @@ def make_handler(state: ApiState):
                     }
                 )
             elif self.path == "/metrics":
+                # refresh the per-chip memory gauges at scrape time (a
+                # no-op list walk on backends without memory_stats)
+                sample_device_memory(state.obs)
                 body = state.obs.render().encode("utf-8")
                 self.send_response(200)
                 self.send_header("Content-Type", state.obs.CONTENT_TYPE)
@@ -913,6 +952,34 @@ def make_handler(state: ApiState):
                         },
                         "queue_depth": queued,
                         "cache_epoch": state.engine.cache_epoch,
+                    }
+                )
+            elif self.path == "/v1/debug/recorder":
+                # the engine flight recorder's ring: the last N
+                # dispatches/compiles/epochs/scheduler decisions
+                self._json(state.recorder.dump())
+            elif self.path == "/v1/debug/memory":
+                stats = sample_device_memory(state.obs)
+                mr = state.mem_report
+                self._json(
+                    {
+                        "devices": stats,
+                        "analytic": {
+                            "params_bytes": mr.params_bytes,
+                            "cache_bytes": mr.cache_bytes,
+                            "total_bytes": mr.total_bytes,
+                            "per_device_bytes": mr.per_device_bytes,
+                        },
+                        "comparison": compare_with_analytic(
+                            mr.per_device_bytes, stats
+                        ),
+                    }
+                )
+            elif self.path == "/v1/debug/compile":
+                self._json(
+                    {
+                        "programs": state.engine.compile_cache_report(),
+                        "cost": state.engine.cost_report(),
                     }
                 )
             elif self.path in ("/health", "/healthz"):
@@ -1105,6 +1172,7 @@ def serve(
     model_name: str = "dllama-tpu",
     chat_template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
     trace_out: str | None = None,
+    postmortem_dir: str | None = None,
 ):
     state = ApiState(
         engine,
@@ -1113,6 +1181,9 @@ def serve(
         chat_template_type,
         tracer=Tracer(sink_path=trace_out) if trace_out else None,
     )
+    if postmortem_dir:
+        # a crashed scheduler loop / engine step dumps the event ring here
+        state.recorder.postmortem_dir = postmortem_dir
     server = ThreadingHTTPServer((host, port), make_handler(state))
     server.state = state  # tests and callers reach the tracer/registry here
     if host in ("0.0.0.0", "127.0.0.1"):
@@ -1163,6 +1234,7 @@ def main(argv=None) -> None:
                 model_name=os.path.basename(args.model),
                 chat_template_type=ttype,
                 trace_out=args.trace_out,
+                postmortem_dir=args.postmortem_dir,
             )
             server.serve_forever()
             return

@@ -181,8 +181,13 @@ class InferenceEngine:
             DEFAULT_TOKEN_BUCKETS_S,
             get_registry,
         )
+        from ..obs.recorder import get_recorder
 
         self.obs = get_registry()
+        # flight recorder (obs/recorder.py): structured engine events —
+        # dispatches, compiles, cache epochs, errors — in a bounded ring;
+        # /v1/debug/recorder dumps it, crashes postmortem it
+        self.recorder = get_recorder()
         self._m_step = self.obs.histogram(
             "dllama_engine_step_seconds",
             "Wall time of one engine dispatch (compiled program call + "
@@ -393,6 +398,7 @@ class InferenceEngine:
         self._compile_lock = _threading.Lock()
         self._inflight: dict = {}  # key -> threading.Event
         self._compile_origin: dict = {}
+        self._compile_seconds: dict = {}  # key -> AOT build wall seconds
 
         if moe_decode_dedup == "auto":
             # decision boundary from the routing-correlation study
@@ -462,6 +468,7 @@ class InferenceEngine:
         # ValueError raised inside a guarded dispatch also rebuilds)
         self.cache_epoch = getattr(self, "cache_epoch", -1) + 1
         self._m_epochs.inc()
+        self.recorder.record("cache_epoch", epoch=self.cache_epoch)
         cache = init_kv_cache(
             self.header,
             self.batch_size,
@@ -490,6 +497,10 @@ class InferenceEngine:
         try:
             yield
         except BaseException as e:
+            self.recorder.record(
+                "error", error=str(e), error_type=type(e).__name__
+            )
+            self.recorder.postmortem("engine-step", e)
             try:
                 self.cache = self._fresh_cache()
             except Exception as rebuild_err:  # pragma: no cover
@@ -578,7 +589,13 @@ class InferenceEngine:
             return last, cache
 
         self._compiled[key] = step
+        self._compile_origin[key] = "dispatch"
         self._m_compiles.labels(origin="dispatch").inc()
+        # lazily jitted: XLA compiles on first call, so there is no build
+        # time to record here — one deferred marker instead of start/end
+        self.recorder.record(
+            "compile", key=str(key), origin="dispatch", deferred=True
+        )
         return step
 
     def _block_arg_specs(self, n_steps: int):
@@ -667,12 +684,20 @@ class InferenceEngine:
             )
             return out, cache
 
+        self.recorder.record("compile_start", key=str(key), origin=origin)
+        t0 = time.perf_counter()
         if self._aot_blocks:
             block = block.lower(*self._block_arg_specs(n_steps)).compile()
+        dt = time.perf_counter() - t0
         with self._compile_lock:
             self._compiled[key] = block
             self._compile_origin[key] = origin
+            if self._aot_blocks:
+                self._compile_seconds[key] = dt
         self._m_compiles.labels(origin=origin).inc()
+        self.recorder.record(
+            "compile_end", key=str(key), origin=origin, s=round(dt, 4)
+        )
         return block
 
     def _prefetch(self, key, builder) -> None:
@@ -764,6 +789,10 @@ class InferenceEngine:
         rng = jax.random.fold_in(
             jax.random.fold_in(self._base_key, pos), self._rng_calls
         )
+        self.recorder.record(
+            "step_dispatch", step="decode_block", pos=pos,
+            n_steps=n_steps, window=window,
+        )
         t0 = time.perf_counter()
         with self._cache_guard():
             out, self.cache = block(
@@ -779,6 +808,10 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         self._m_step.labels(kind="decode_block").observe(dt)
         self._m_tpot.observe(dt / n_steps)
+        self.recorder.record(
+            "step_complete", step="decode_block", pos=pos,
+            n_steps=n_steps, window=window, ms=round(dt * 1000, 3),
+        )
         if per_lane:
             return [[int(t) for t in row] for row in out]
         return [int(t) for t in out[:, 0]]
@@ -813,7 +846,11 @@ class InferenceEngine:
             return jnp.sum(nll[0]), cache
 
         self._compiled[key] = score
+        self._compile_origin[key] = "dispatch"
         self._m_compiles.labels(origin="dispatch").inc()
+        self.recorder.record(
+            "compile", key=str(key), origin="dispatch", deferred=True
+        )
         return score
 
     def perplexity(self, tokens: list[int]) -> tuple[float, float, int]:
@@ -916,7 +953,11 @@ class InferenceEngine:
             return cache
 
         self._compiled[key] = step
+        self._compile_origin[key] = "dispatch"
         self._m_compiles.labels(origin="dispatch").inc()
+        self.recorder.record(
+            "compile", key=str(key), origin="dispatch", deferred=True
+        )
         return step
 
     def prefill_lane(self, lane: int, tokens: list[int], pos0: int = 0) -> None:
@@ -940,6 +981,10 @@ class InferenceEngine:
             )
         fills = tokens[:-1]
         p = pos0
+        self.recorder.record(
+            "step_dispatch", step="prefill_lane", lane=lane, pos=pos0,
+            n_tokens=len(fills),
+        )
         t0 = time.perf_counter()
         while fills:
             bucket = self._bucket_for(len(fills), p)
@@ -961,8 +1006,11 @@ class InferenceEngine:
                 self.cache = step(self.params, arr, self.cache, pos_arr)
             p += width
         if p > pos0:
-            self._m_step.labels(kind="prefill_lane").observe(
-                time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self._m_step.labels(kind="prefill_lane").observe(dt)
+            self.recorder.record(
+                "step_complete", step="prefill_lane", lane=lane, pos=pos0,
+                n_tokens=p - pos0, ms=round(dt * 1000, 3),
             )
 
     def _lane_arg_specs(self, n_steps: int):
@@ -1053,12 +1101,20 @@ class InferenceEngine:
             )
             return out, cache
 
+        self.recorder.record("compile_start", key=str(key), origin=origin)
+        t0 = time.perf_counter()
         if self._aot_blocks:
             block = block.lower(*self._lane_arg_specs(n_steps)).compile()
+        dt = time.perf_counter() - t0
         with self._compile_lock:
             self._compiled[key] = block
             self._compile_origin[key] = origin
+            if self._aot_blocks:
+                self._compile_seconds[key] = dt
         self._m_compiles.labels(origin=origin).inc()
+        self.recorder.record(
+            "compile_end", key=str(key), origin=origin, s=round(dt, 4)
+        )
         return block
 
     def decode_lanes(
@@ -1132,6 +1188,10 @@ class InferenceEngine:
              ) & 0x7FFFFFFF
             for i, s in enumerate(seeds or [None] * self.batch_size)
         ]
+        self.recorder.record(
+            "step_dispatch", step="decode_lanes", pos=deepest,
+            n_steps=n_steps, window=window, n_live=len(live),
+        )
         t0 = time.perf_counter()
         with self._cache_guard():
             out, self.cache = block(
@@ -1149,6 +1209,11 @@ class InferenceEngine:
         self._m_step.labels(kind="decode_lanes").observe(dt)
         # each active stream advances one token per block row
         self._m_tpot.observe(dt / n_steps)
+        self.recorder.record(
+            "step_complete", step="decode_lanes", pos=deepest,
+            n_steps=n_steps, window=window, n_live=len(live),
+            ms=round(dt * 1000, 3),
+        )
         return [[int(t) for t in row] for row in out_np]
 
     def _bucket_for(self, n: int, pos: int) -> int:
@@ -1212,8 +1277,11 @@ class InferenceEngine:
             fills = [fill[width:] for fill in fills]
             arr = jnp.asarray(padded, dtype=jnp.int32)
             arr = jax.device_put(arr, self._token_sharding)
-            step = self._step_fn(
-                bucket, greedy=False, window=self._attn_window(p + bucket)
+            window = self._attn_window(p + bucket)
+            step = self._step_fn(bucket, greedy=False, window=window)
+            self.recorder.record(
+                "step_dispatch", step="prefill", pos=p,
+                bucket=bucket, window=window,
             )
             t0 = time.perf_counter()
             # Padding tokens write garbage into cache slots [p+width,
@@ -1228,7 +1296,12 @@ class InferenceEngine:
                 ck = self.cache["k"]
                 ck = ck.q if hasattr(ck, "q") else ck
                 np.asarray(jax.device_get(ck[0, 0, 0, 0, 0]))
-            total_ms += (time.perf_counter() - t0) * 1000
+            chunk_ms = (time.perf_counter() - t0) * 1000
+            total_ms += chunk_ms
+            self.recorder.record(
+                "step_complete", step="prefill", pos=p,
+                bucket=bucket, window=window, ms=round(chunk_ms, 3),
+            )
             p += width
         return StepStats(time_ms=total_ms, n_tokens=max(n - 1, 0))
 
@@ -1250,12 +1323,20 @@ class InferenceEngine:
         arr = jnp.asarray([[token]] * self.batch_size, dtype=jnp.int32)
         arr = jax.device_put(arr, self._token_sharding)
         greedy = self.temperature == 0.0
-        step = self._step_fn(1, greedy=greedy, window=self._attn_window(pos + 1))
+        window = self._attn_window(pos + 1)
+        step = self._step_fn(1, greedy=greedy, window=window)
+        self.recorder.record(
+            "step_dispatch", step="decode_step", pos=pos, window=window
+        )
         t0 = time.perf_counter()
         with self._cache_guard():
             out, self.cache = step(self.params, arr, self.cache, jnp.int32(pos))
             out = jax.block_until_ready(out)
         ms = (time.perf_counter() - t0) * 1000
+        self.recorder.record(
+            "step_complete", step="decode_step", pos=pos, window=window,
+            ms=round(ms, 3),
+        )
         if greedy:
             next_token = int(np.asarray(out)[0])
         else:
@@ -1384,4 +1465,102 @@ class InferenceEngine:
                         if pos[lane] >= max_pos:
                             active[lane] = False
         return outs
+
+    # -- introspection (obs) -------------------------------------------------
+
+    @staticmethod
+    def _key_kind(key) -> str:
+        """Step kind of a compile-cache key, matching the
+        `dllama_engine_step_seconds{kind=}` label values where one exists."""
+        if isinstance(key, tuple) and key and isinstance(key[0], str):
+            return {
+                "block": "decode_block",
+                "lane_block": "decode_lanes",
+                "lane_prefill": "prefill_lane",
+                "score": "score",
+            }.get(key[0], key[0])
+        return "prefill"  # plain (t, greedy, window) keys
+
+    def compile_cache_report(self) -> list[dict]:
+        """Per-key view of the compile cache (what `/v1/debug/compile`
+        serves): the cache key, its step kind, who built it
+        (dispatch/prefetch), the AOT build wall seconds where measured,
+        and XLA's cost analysis — or the explicit ``"unavailable"``
+        marker for lazily jitted programs, which expose no executable
+        until their first call."""
+        from ..obs.cost import extract_cost
+
+        with self._compile_lock:
+            items = list(self._compiled.items())
+            origins = dict(self._compile_origin)
+            seconds = dict(self._compile_seconds)
+        out = []
+        for key, fn in items:
+            cost = extract_cost(fn)
+            out.append(
+                {
+                    "key": list(key),
+                    "kind": self._key_kind(key),
+                    "origin": origins.get(key, "dispatch"),
+                    "compile_seconds": seconds.get(key),
+                    "cost": cost if cost is not None else "unavailable",
+                }
+            )
+        return out
+
+    def cost_report(self) -> dict:
+        """Fold the compile cache into per-kind cost gauges and an
+        achieved-vs-roofline fraction from the measured step histograms.
+
+        The representative program per kind is the one accessing the most
+        bytes (the widest attention window — what bounds steady-state
+        decode); its roofline fraction divides achieved bytes/s
+        (cost-analysis bytes / mean measured step seconds) by the chip's
+        HBM peak. Fractions are absent when the backend's peak is unknown
+        (CPU) or the kind has no measured steps yet."""
+        from ..obs.cost import hbm_peak_bytes_per_s, roofline_fraction
+
+        g_flops = self.obs.gauge(
+            "dllama_compiled_step_flops",
+            "XLA cost-analysis flops of the representative (most "
+            "bytes-accessed) compiled program, per step kind.",
+            labelnames=("kind",),
+        )
+        g_bytes = self.obs.gauge(
+            "dllama_compiled_step_bytes_accessed",
+            "XLA cost-analysis bytes accessed of the representative "
+            "compiled program, per step kind.",
+            labelnames=("kind",),
+        )
+        g_roof = self.obs.gauge(
+            "dllama_step_roofline_fraction",
+            "Achieved HBM bandwidth (cost-analysis bytes / mean measured "
+            "step seconds) over the chip's peak, per step kind; only set "
+            "when both a cost and a known peak exist.",
+            labelnames=("kind",),
+        )
+        peak = hbm_peak_bytes_per_s()
+        per_kind: dict[str, dict] = {}
+        for e in self.compile_cache_report():
+            cost = e["cost"]
+            if not isinstance(cost, dict):
+                continue
+            cur = per_kind.get(e["kind"])
+            if cur is None or cost["bytes_accessed"] > cur["bytes_accessed"]:
+                per_kind[e["kind"]] = {
+                    "key": e["key"],
+                    "flops": cost["flops"],
+                    "bytes_accessed": cost["bytes_accessed"],
+                }
+        for kind, info in per_kind.items():
+            g_flops.labels(kind=kind).set(info["flops"])
+            g_bytes.labels(kind=kind).set(info["bytes_accessed"])
+            hist = self._m_step.labels(kind=kind)
+            mean_s = (hist.sum / hist.count) if hist.count else 0.0
+            info["mean_step_s"] = mean_s if mean_s > 0 else None
+            frac = roofline_fraction(info["bytes_accessed"], mean_s, peak)
+            info["roofline_fraction"] = frac
+            if frac is not None:
+                g_roof.labels(kind=kind).set(frac)
+        return {"hbm_peak_bytes_per_s": peak, "kinds": per_kind}
 

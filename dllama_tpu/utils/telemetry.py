@@ -211,16 +211,43 @@ def measure_sync_ms(run_fn, steps: int = 3) -> float | None:
 
 @contextlib.contextmanager
 def profile(log_dir: str | None):
-    """jax.profiler trace scope; no-op when log_dir is falsy."""
+    """jax.profiler trace scope; no-op when log_dir is falsy.
+
+    Profiler failures degrade to a logged warning instead of killing the
+    run: start_trace raises on a double-start (another profiler session
+    alive in the process) and some backends lack the profiler service
+    entirely — neither should take down the generation being profiled."""
     if not log_dir:
         yield
         return
-    jax.profiler.start_trace(log_dir)
+    import logging
+
+    log = logging.getLogger(__name__)
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:
+        log.warning(
+            "jax.profiler.start_trace(%r) failed (already tracing, or "
+            "profiler unavailable on this backend); continuing unprofiled",
+            log_dir,
+            exc_info=True,
+        )
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
-        print(f"🔬 Profile trace written to {log_dir}")
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                print(f"🔬 Profile trace written to {log_dir}")
+            except Exception:
+                log.warning(
+                    "jax.profiler.stop_trace() failed; the trace under %r "
+                    "may be incomplete",
+                    log_dir,
+                    exc_info=True,
+                )
 
 
 class Counter:

@@ -32,3 +32,58 @@ def test_real_record_carries_ratio():
     assert rec["metric"] == "decode_tok_s_per_chip_llama_8b_q40i8_kv8"
     assert rec["comparable"] is True
     assert rec["vs_baseline"] == round(55.0 / NORTH_STAR_TOK_S_PER_CHIP, 3)
+
+
+def test_bench_summaries_section_split():
+    from bench import bench_summaries
+
+    result = {
+        "metric": "decode_tok_s_per_chip_tiny_q40",
+        "value": 12.3, "unit": "tokens/s/chip", "vs_baseline": 0.25,
+        "comparable": True, "weight_gbs_per_chip": 100.0,
+        "step_ms": {"block_tokens": 64, "n_blocks": 5, "p50": 10.0,
+                    "p90": 12.0, "max": 13.0, "per_token_p50": 0.156},
+        "ttft_ms_p50": 42.5,
+        "lanes4_tok_s_per_chip": 30.0,
+        "format_sweep_tok_s_per_chip": {"q40": 12.3, "q40i8": 14.0},
+        "serving": {"n_clients": 3, "ttft_ms_p50": 50.0,
+                    "obs_overhead_pct": 0.4},
+    }
+    out = bench_summaries(result)
+    assert set(out) == {"DECODE", "TTFT", "LANES", "SWEEP", "SERVING"}
+    assert out["DECODE"]["value"] == 12.3
+    assert out["DECODE"]["step_ms"]["p90"] == 12.0
+    assert out["TTFT"]["ttft_ms_p50"] == 42.5
+    assert out["LANES"]["lanes4_tok_s_per_chip"] == 30.0
+    assert out["SWEEP"]["tok_s_per_chip"]["q40i8"] == 14.0
+    assert out["SERVING"]["obs_overhead_pct"] == 0.4
+
+
+def test_bench_summaries_only_sections_that_ran():
+    from bench import bench_summaries
+
+    out = bench_summaries({
+        "metric": "decode_tok_s_per_chip_tiny_q40_cpu_fallback",
+        "value": 1.0, "unit": "tokens/s/chip", "vs_baseline": None,
+        "comparable": False,
+    })
+    assert set(out) == {"DECODE"}  # skipped sections leave no stale files
+    assert bench_summaries({}) == {}
+
+
+def test_write_bench_summaries_files(tmp_path):
+    import json
+
+    from bench import write_bench_summaries
+
+    result = {"metric": "m", "value": 1.0, "unit": "tokens/s/chip",
+              "vs_baseline": None, "comparable": False,
+              "ttft_ms_p50": 9.0}
+    paths = write_bench_summaries(result, out_dir=str(tmp_path))
+    assert sorted(p.split("/")[-1] for p in paths) == [
+        "BENCH_DECODE.json", "BENCH_TTFT.json",
+    ]
+    decode = json.loads((tmp_path / "BENCH_DECODE.json").read_text())
+    assert decode["metric"] == "m" and decode["comparable"] is False
+    # unwritable destination degrades to a logged skip, never a crash
+    assert write_bench_summaries(result, out_dir=str(tmp_path / "no" / "x")) == []

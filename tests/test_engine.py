@@ -894,3 +894,63 @@ def test_engine_obs_counters(tiny_model):
     assert crossings.value == b_w + 1
     e._note_window(32)  # shrink (fresh request): no crossing
     assert crossings.value == b_w + 1
+
+
+def test_compile_cache_report_and_cost(tiny_model):
+    """Engine introspection behind /v1/debug/compile: every cached
+    program is classified by kind with its compile origin, AOT block
+    programs carry real XLA cost analysis (even on CPU), and cost_report
+    folds them into per-kind figures with the roofline fraction honestly
+    absent when the backend's HBM peak is unknown."""
+    mp, _ = tiny_model
+    eng = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    assert InferenceEngine._key_kind(("block", 8, True, 64)) == "decode_block"
+    assert InferenceEngine._key_kind(("lane_block", 8, 64)) == "decode_lanes"
+    assert InferenceEngine._key_kind(("lane_prefill", 8, 64)) == "prefill_lane"
+    assert InferenceEngine._key_kind(("score", 8, 64)) == "score"
+    assert InferenceEngine._key_kind((8, True, 64)) == "prefill"
+
+    eng.generate([1, 2, 3], max_steps=10)
+    report = eng.compile_cache_report()
+    assert report
+    kinds = {e["kind"] for e in report}
+    assert "decode_block" in kinds
+    for e in report:
+        assert e["origin"] in ("dispatch", "prefetch", "prefetch-failed")
+        assert e["cost"] == "unavailable" or e["cost"]["bytes_accessed"] > 0
+    blocks = [e for e in report if e["kind"] == "decode_block"]
+    if eng._aot_blocks:
+        assert any(isinstance(e["cost"], dict) for e in blocks)
+        assert all(e["compile_seconds"] is not None for e in blocks)
+
+    cost = eng.cost_report()
+    if eng._aot_blocks:
+        info = cost["kinds"]["decode_block"]
+        assert info["bytes_accessed"] > 0 and info["mean_step_s"] > 0
+        if cost["hbm_peak_bytes_per_s"] is None:  # CPU test backend
+            assert info["roofline_fraction"] is None
+        # the per-kind gauges took the same values
+        g = eng.obs.gauge(
+            "dllama_compiled_step_bytes_accessed", labelnames=("kind",))
+        assert g.child_values()[("decode_block",)] == info["bytes_accessed"]
+
+
+def test_recorder_captures_engine_events(tiny_model):
+    """One generate() leaves a coherent event trail in the flight
+    recorder: dispatches paired with completes, and the KV-cache epoch
+    event from engine init."""
+    from dllama_tpu.obs.recorder import get_recorder
+
+    rec = get_recorder()
+    base_seq = rec.total_recorded
+    mp, _ = tiny_model
+    eng = InferenceEngine(mp, tp=1, dtype=jnp.float32, temperature=0.0)
+    eng.generate([1, 2, 3], max_steps=8)
+    new = [e for e in rec.events() if e["seq"] > base_seq]
+    kinds = [e["kind"] for e in new]
+    assert "cache_epoch" in kinds
+    assert "step_dispatch" in kinds and "step_complete" in kinds
+    completes = [e for e in new if e["kind"] == "step_complete"]
+    assert completes and all(e["ms"] >= 0 for e in completes)
+    steps = {e.get("step") for e in completes}
+    assert "prefill" in steps and "decode_block" in steps

@@ -202,3 +202,350 @@ def test_telemetry_counter_on_registry():
     anon = Counter()
     anon.add(1.0)
     assert anon.n == 1
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_recorder_ring_overflow_keeps_newest():
+    from dllama_tpu.obs.recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("step_dispatch", i=i)
+    evs = rec.events()
+    assert len(evs) == 4
+    assert [e["i"] for e in evs] == [6, 7, 8, 9]  # oldest fell off
+    assert [e["seq"] for e in evs] == [7, 8, 9, 10]  # lifetime index survives
+    assert rec.total_recorded == 10
+    d = rec.dump()
+    assert d["n_events"] == 4 and d["total_recorded"] == 10
+    assert d["dropped"] == 6 and d["capacity"] == 4
+    assert json.loads(rec.dump_json())["n_events"] == 4
+    assert rec.events(kind="nope") == []
+    rec.clear()
+    assert rec.events() == []
+    assert rec.total_recorded == 10  # clear drops events, not the ledger
+
+
+def test_recorder_thread_safety():
+    from dllama_tpu.obs.recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=128)
+
+    def work(tid):
+        for i in range(500):
+            rec.record("e", tid=tid, i=i)
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rec.total_recorded == 4000
+    evs = rec.events()
+    assert len(evs) == 128
+    seqs = [e["seq"] for e in evs]
+    assert len(set(seqs)) == 128 and max(seqs) == 4000
+    assert seqs == sorted(seqs)  # ring preserves recording order
+
+
+def test_recorder_disabled_is_noop():
+    from dllama_tpu.obs.recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=4, enabled=False)
+    rec.record("e")
+    assert rec.events() == [] and rec.total_recorded == 0
+    rec.enable()
+    rec.record("e")
+    assert rec.total_recorded == 1
+    rec.disable()
+    rec.record("e")
+    assert rec.total_recorded == 1
+
+
+def test_recorder_postmortem_dump(tmp_path):
+    from dllama_tpu.obs.recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=16, postmortem_dir=str(tmp_path / "pm"))
+    rec.record("step_dispatch", step="decode_block", pos=7)
+    path = rec.postmortem("engine-step", RuntimeError("kaboom"))
+    assert path is not None
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "engine-step"
+    assert payload["error"] == "kaboom"
+    assert payload["error_type"] == "RuntimeError"
+    kinds = [e["kind"] for e in payload["events"]]
+    assert kinds == ["step_dispatch", "postmortem"]  # ring + the marker
+    # a second postmortem gets a distinct file
+    path2 = rec.postmortem("scheduler-loop", "plain string error")
+    assert path2 is not None and path2 != path
+    with open(path2) as f:
+        p2 = json.load(f)
+    assert p2["error"] == "plain string error" and p2["error_type"] is None
+
+
+def test_recorder_postmortem_never_raises(tmp_path):
+    from dllama_tpu.obs.recorder import FlightRecorder
+
+    # no dir configured -> None, events still recorded
+    rec = FlightRecorder(capacity=4)
+    assert rec.postmortem("x", RuntimeError("e")) is None
+    assert rec.events(kind="postmortem")
+    # dir path blocked by a plain file -> swallowed, None returned
+    blocker = tmp_path / "blocked"
+    blocker.write_text("not a directory")
+    rec.postmortem_dir = str(blocker)
+    assert rec.postmortem("x", RuntimeError("e")) is None
+
+
+def test_get_recorder_is_process_singleton():
+    from dllama_tpu.obs.recorder import get_recorder
+
+    assert get_recorder() is get_recorder()
+
+
+# -- cost analysis + roofline ------------------------------------------------
+
+
+class _FakeCompiled:
+    def __init__(self, ca):
+        self._ca = ca
+
+    def cost_analysis(self):
+        if isinstance(self._ca, Exception):
+            raise self._ca
+        return self._ca
+
+
+def test_extract_cost_shapes():
+    from dllama_tpu.obs.cost import extract_cost
+
+    assert extract_cost(object()) is None  # lazily jitted fn: no surface
+    assert extract_cost(_FakeCompiled(RuntimeError("no"))) is None
+    assert extract_cost(_FakeCompiled(None)) is None
+    assert extract_cost(_FakeCompiled([])) is None
+    assert extract_cost(_FakeCompiled([{}])) is None
+    # jax has shipped both one-dict-per-module lists and bare dicts
+    got = extract_cost(
+        _FakeCompiled([{"flops": 10.0, "bytes accessed": 20.0}])
+    )
+    assert got == {"flops": 10.0, "bytes_accessed": 20.0}
+    got = extract_cost(_FakeCompiled({"flops": 3.0}))
+    assert got == {"flops": 3.0, "bytes_accessed": 0.0}
+
+
+def test_extract_cost_real_aot_executable():
+    """The integration the /v1/debug/compile endpoint rides on: a real
+    AOT-compiled executable reports non-empty cost analysis on CPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.obs.cost import extract_cost
+
+    x = jnp.ones((8, 8), jnp.float32)
+    compiled = jax.jit(lambda a, b: a @ b).lower(x, x).compile()
+    cost = extract_cost(compiled)
+    assert cost is not None and cost["flops"] > 0
+
+
+def test_roofline_fraction():
+    from dllama_tpu.obs.cost import roofline_fraction
+
+    assert roofline_fraction(1e9, 0.002, 819e9) == pytest.approx(
+        (1e9 / 0.002) / 819e9
+    )
+    assert roofline_fraction(1e9, 0.002, None) is None
+    assert roofline_fraction(1e9, 0.0, 819e9) is None
+    assert roofline_fraction(0.0, 0.002, 819e9) is None
+
+
+def test_weight_bytes_per_token_formats():
+    from types import SimpleNamespace
+
+    from dllama_tpu.obs.cost import weight_bytes_per_token
+
+    h = SimpleNamespace(dim=4, q_dim=4, kv_dim=2, ff_dim=8, n_layers=1,
+                        vocab_size=10, n_experts=0, n_active_experts=0)
+    att = 4 * 4 + 2 * 4 * 2 + 4 * 4     # 48
+    ffn = 3 * 4 * 8                      # 96
+    base = att + ffn + 4 * 10            # + embed/cls read
+    assert weight_bytes_per_token(h, "q40") == int(base * 1.125)
+    assert weight_bytes_per_token(h, "bf16") == base * 2
+    assert weight_bytes_per_token(h, "q40i4") == int(base * 0.5625)
+    assert weight_bytes_per_token(h, "q40i8", i8_group=64) == int(
+        base * (1 + 4 / 64)
+    )
+
+
+def test_roofline_report_degrades_without_tpu():
+    """On the CPU test backend the HBM peak is unknown: every derived
+    figure is an explicit None, never a made-up fraction."""
+    from types import SimpleNamespace
+
+    from dllama_tpu.obs.cost import (
+        hbm_peak_bytes_per_s,
+        print_roofline_report,
+        roofline_report,
+    )
+
+    assert hbm_peak_bytes_per_s() is None
+    h = SimpleNamespace(dim=64, q_dim=64, kv_dim=32, ff_dim=160, n_layers=2,
+                        vocab_size=288, n_experts=0, n_active_experts=0)
+    rep = roofline_report(h, "q40", tp=2)
+    assert rep["weight_bytes_per_token_per_chip"] > 0
+    assert rep["hbm_peak_bytes_per_s"] is None
+    assert rep["min_ms_per_token"] is None
+    assert rep["max_tok_s_per_chip"] is None
+    # tp*pp shards the weight reads
+    assert rep["weight_bytes_per_token_per_chip"] == pytest.approx(
+        roofline_report(h, "q40")["weight_bytes_per_token_per_chip"] // 2,
+        abs=1,
+    )
+    assert print_roofline_report(h, "q40", tp=2) == rep  # prints, returns same
+
+
+# -- device memory telemetry -------------------------------------------------
+
+
+def test_device_memory_stats_shape():
+    import jax
+
+    from dllama_tpu.obs.device import device_memory_stats
+
+    stats = device_memory_stats()
+    assert len(stats) == len(jax.devices())
+    for s in stats:
+        assert set(s) >= {"device", "platform", "available"}
+        if s["available"]:
+            assert s["bytes_in_use"] >= 0 and s["bytes_limit"] >= 0
+        else:
+            assert "bytes_in_use" not in s  # no fabricated zeros
+
+
+def test_sample_device_memory_registers_gauges():
+    from dllama_tpu.obs.device import sample_device_memory
+
+    reg = MetricsRegistry()
+    stats = sample_device_memory(reg)
+    text = reg.render()
+    for fam in ("dllama_device_bytes_in_use",
+                "dllama_device_peak_bytes_in_use",
+                "dllama_device_bytes_limit"):
+        assert f"# TYPE {fam} gauge" in text
+    for s in stats:
+        if s["available"]:  # TPU run: the gauge really carries the sample
+            assert f'dllama_device_bytes_in_use{{device="{s["device"]}"}}' \
+                in text
+
+
+def test_compare_with_analytic_divergence(caplog):
+    import logging
+
+    from dllama_tpu.obs.device import compare_with_analytic
+
+    ok = [{"device": "d0", "platform": "tpu", "available": True,
+           "bytes_in_use": 105, "peak_bytes_in_use": 110, "bytes_limit": 200}]
+    with caplog.at_level(logging.WARNING, logger="dllama_tpu.obs.device"):
+        cmp_ok = compare_with_analytic(100, stats=ok)
+    assert cmp_ok["available"] is True
+    assert cmp_ok["max_divergence_fraction"] == pytest.approx(0.05)
+    assert not caplog.records  # within tolerance: silent
+
+    bad = [dict(ok[0], bytes_in_use=130)]
+    with caplog.at_level(logging.WARNING, logger="dllama_tpu.obs.device"):
+        cmp_bad = compare_with_analytic(100, stats=bad)
+    assert cmp_bad["max_divergence_fraction"] == pytest.approx(0.30)
+    assert any("diverges" in r.message for r in caplog.records)
+
+
+def test_compare_with_analytic_unavailable():
+    from dllama_tpu.obs.device import compare_with_analytic
+
+    none_avail = [{"device": "cpu:0", "platform": "cpu", "available": False}]
+    cmp_ = compare_with_analytic(100, stats=none_avail)
+    assert cmp_["available"] is False
+    assert cmp_["max_divergence_fraction"] is None and cmp_["per_chip"] == []
+    assert compare_with_analytic(0, stats=[])["available"] is False
+
+
+# -- telemetry hardening + consistency ---------------------------------------
+
+
+def test_profile_survives_start_trace_failure(monkeypatch, caplog):
+    import logging
+
+    import jax
+
+    from dllama_tpu.utils import telemetry
+
+    def bad_start(d):
+        raise RuntimeError("profiler already active")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", bad_start)
+    ran = False
+    with caplog.at_level(logging.WARNING, logger="dllama_tpu.utils.telemetry"):
+        with telemetry.profile("/tmp/nowhere"):
+            ran = True  # the profiled body still runs
+    assert ran
+    assert any("start_trace" in r.message for r in caplog.records)
+
+
+def test_profile_survives_stop_trace_failure(monkeypatch, caplog):
+    import logging
+
+    import jax
+
+    from dllama_tpu.utils import telemetry
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+
+    def bad_stop():
+        raise RuntimeError("trace collection died")
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", bad_stop)
+    with caplog.at_level(logging.WARNING, logger="dllama_tpu.utils.telemetry"):
+        with telemetry.profile("/tmp/nowhere"):
+            pass
+    assert any("stop_trace" in r.message for r in caplog.records)
+
+
+def test_profile_noop_without_log_dir(monkeypatch):
+    import jax
+
+    from dllama_tpu.utils import telemetry
+
+    def explode(*a):
+        raise AssertionError("profiler must not be touched")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", explode)
+    with telemetry.profile(None):
+        pass
+    with telemetry.profile(""):
+        pass
+
+
+def test_replicated_keys_match_param_spec_tree():
+    """Pin telemetry's replication list to the sharding layout it models:
+    the keys memory_report treats as whole-on-every-chip must be exactly
+    the P() leaves of parallel/sharding.param_spec_tree across all
+    arches. A sharding change that replicates or splits a new leaf must
+    touch both files (this test is the tripwire)."""
+    from types import SimpleNamespace
+
+    from jax.sharding import PartitionSpec as P
+
+    from dllama_tpu.formats.model_file import LlmArch
+    from dllama_tpu.parallel.sharding import param_spec_tree
+    from dllama_tpu.utils.telemetry import _REPLICATED_KEYS
+
+    replicated = set()
+    for arch in (LlmArch.LLAMA, LlmArch.QWEN3, LlmArch.QWEN3_MOE):
+        spec = param_spec_tree(SimpleNamespace(arch=arch))
+        layers = spec.pop("layers")
+        for scope in (spec, layers):
+            for key, leaf_spec in scope.items():
+                if leaf_spec == P():
+                    replicated.add(key)
+    assert replicated == _REPLICATED_KEYS
