@@ -272,8 +272,12 @@ def _serving_smoke(n_clients: int) -> dict:
     engine = InferenceEngine(
         model_path, tokenizer=tok, batch_size=n_lanes, temperature=0.0
     )
+    # a small explicit admission chunk so the churn scenario below pays
+    # several chunks per long-prompt admission (the default — the largest
+    # prefill bucket, 128 here — would swallow the whole prompt in one)
     srv = serve(
-        engine, tok, host="127.0.0.1", port=0, trace_out=trace_path
+        engine, tok, host="127.0.0.1", port=0, trace_out=trace_path,
+        admission_chunk=32,
     )
     port = srv.server_address[1]
     threading.Thread(target=srv.serve_forever, daemon=True).start()
@@ -301,15 +305,99 @@ def _serving_smoke(n_clients: int) -> dict:
     for t in threads:
         t.join()
 
-    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
-    conn.request("GET", "/metrics")
-    metrics_text = conn.getresponse().read().decode("utf-8")
-    conn.close()
+    def scrape_metrics() -> str:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        c.request("GET", "/metrics")
+        text = c.getresponse().read().decode("utf-8")
+        c.close()
+        return text
+
+    def metric_value(text: str, name: str) -> float:
+        m = re.search(rf"^{name} ([0-9.eE+-]+)$", text, re.M)
+        return float(m.group(1)) if m else 0.0
+
+    # admission-churn scenario (the headline for chunked admission): one
+    # victim client streams a long completion while two long-prompt
+    # requests are admitted mid-stream; the victim's max/p99 inter-delta
+    # gap is what a monolithic prefill would have blown up to the whole
+    # prefill time
+    victim_arrivals: list[float] = []
+    first_delta = threading.Event()
+
+    def victim_request() -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        conn.request(
+            "POST", "/v1/chat/completions",
+            json.dumps({
+                "messages": [{"role": "user", "content": "v"}],
+                "max_tokens": 48, "stream": True,
+            }),
+            {"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        while True:
+            line = r.readline()
+            if not line or b"[DONE]" in line:
+                break
+            if line.startswith(b"data:"):
+                victim_arrivals.append(time.perf_counter())
+                first_delta.set()
+        conn.close()
+
+    vt = threading.Thread(target=victim_request)
+    vt.start()
+    first_delta.wait(timeout=120)
+    pre_churn = scrape_metrics()  # victim admitted; churn not started
+    long_prompt = "x" * 120  # ~200 prompt tokens with the chat template
+
+    def churn_request(i: int) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        conn.request(
+            "POST", "/v1/chat/completions",
+            json.dumps({
+                "messages": [
+                    {"role": "user", "content": f"{long_prompt}{i}"}
+                ],
+                "max_tokens": 2,
+            }),
+            {"Content-Type": "application/json"},
+        )
+        conn.getresponse().read()
+        conn.close()
+
+    churners = [
+        threading.Thread(target=churn_request, args=(i,)) for i in range(2)
+    ]
+    for t in churners:
+        t.start()
+    for t in churners + [vt]:
+        t.join()
+
+    metrics_text = scrape_metrics()
     srv.shutdown()
 
     def hist_count(name: str) -> int:
         m = re.search(rf"^{name}_count (\d+)", metrics_text, re.M)
         return int(m.group(1)) if m else 0
+
+    gaps_ms = sorted(
+        (b - a) * 1000
+        for a, b in zip(victim_arrivals, victim_arrivals[1:])
+    )
+    churn_chunks = (
+        metric_value(metrics_text, "dllama_admission_chunks_total")
+        - metric_value(pre_churn, "dllama_admission_chunks_total")
+    )
+    admission_churn = {
+        "n_gaps": len(gaps_ms),
+        "max_gap_ms": round(gaps_ms[-1], 2) if gaps_ms else None,
+        "p99_gap_ms": (
+            round(gaps_ms[min(len(gaps_ms) - 1,
+                              int(0.99 * (len(gaps_ms) - 1)))], 2)
+            if gaps_ms else None
+        ),
+        "chunks_per_admission": round(churn_chunks / 2, 1),
+    }
 
     recs = [r for r in read_jsonl(trace_path) if r["ttft_s"] is not None]
     ttfts = sorted(r["ttft_s"] * 1000 for r in recs)
@@ -355,6 +443,14 @@ def _serving_smoke(n_clients: int) -> dict:
         ),
         "ttft_hist_count": hist_count("dllama_ttft_seconds"),
         "tpot_hist_count": hist_count("dllama_tpot_seconds"),
+        "admission_churn": admission_churn,
+        "admission_chunks_total": int(
+            metric_value(metrics_text, "dllama_admission_chunks_total")
+        ),
+        "decode_stall_count": hist_count("dllama_decode_stall_seconds"),
+        "decode_stall_sum_s": round(
+            metric_value(metrics_text, "dllama_decode_stall_seconds_sum"), 4
+        ),
         "obs_overhead_pct": round(overhead_pct, 2),
     }
 

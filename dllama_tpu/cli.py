@@ -47,6 +47,20 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                    help="decode lanes: >1 lets the API server stream "
                         "multiple requests concurrently (per-lane "
                         "positions over the dp batch axis)")
+    p.add_argument("--lane-block-size", type=int, default=None,
+                   dest="lane_block_size", metavar="N",
+                   help="decode tokens per lane-scheduler block (default: "
+                        "env DLLAMA_LANE_BLOCK, else 8) — with "
+                        "--admission-chunk this bounds the worst-case "
+                        "inter-token gap at one chunk + one block")
+    p.add_argument("--admission-chunk", type=int, default=None,
+                   dest="admission_chunk", metavar="TOKENS",
+                   help="max prompt tokens prefilled per scheduler tick "
+                        "while admitting a request (default: env "
+                        "DLLAMA_ADMISSION_CHUNK, else the largest prefill "
+                        "bucket); smaller = tighter inter-token gaps for "
+                        "active streams, larger = faster TTFT for the "
+                        "incoming prompt")
     p.add_argument("--tp", type=int, default=0, help="tensor-parallel chips (default: all)")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel chips: shard the KV cache's "

@@ -155,6 +155,49 @@ class _LaneState:
     prompt_end: int = 0
 
 
+@dataclass
+class _AdmittingLane:
+    """A request mid-admission: its prompt prefills one bounded chunk per
+    scheduler tick (interleaved with decode blocks for the active lanes)
+    instead of one monolithic prefill_lane call that freezes every other
+    stream for the whole prompt. Everything the old _admit computed before
+    touching the engine lives here, held across loop iterations until the
+    last fill token lands and the lane flips to a _LaneState."""
+
+    job: LaneJob
+    tokens: list[int]  # full delta prompt, pending token included
+    pos0: int
+    cursor: int  # fill tokens already written to the lane's cache
+    prompt_end: int
+    max_pos: int
+    public_prompt: str
+    delta_messages: list
+    start_pos: int  # reused prefix length (0 = fresh prefill)
+    n_chunks: int = 0
+    prefill_s: float = 0.0  # chunk dispatch time only, decode excluded
+
+
+def _env_int(name: str, default: int) -> int:
+    import os
+
+    v = os.environ.get(name, "")
+    return int(v) if v else default
+
+
+def resolve_lane_knobs(
+    lane_block_size: int | None = None, admission_chunk: int | None = None
+) -> tuple[int, int]:
+    """Scheduler knob resolution: explicit value (CLI flag) beats the env
+    override (DLLAMA_LANE_BLOCK / DLLAMA_ADMISSION_CHUNK) beats the
+    default (block 8; admission chunk 0 = auto, the engine's largest
+    prefill bucket)."""
+    if lane_block_size is None:
+        lane_block_size = _env_int("DLLAMA_LANE_BLOCK", 8)
+    if admission_chunk is None:
+        admission_chunk = _env_int("DLLAMA_ADMISSION_CHUNK", 0)
+    return int(lane_block_size), int(admission_chunk)
+
+
 class LaneScheduler:
     """Continuous-batching loop over the engine's batch lanes.
 
@@ -175,10 +218,24 @@ class LaneScheduler:
     exactly the tokens the conversation produced.
     """
 
-    def __init__(self, state: "ApiState", block_size: int = 8):
+    def __init__(
+        self,
+        state: "ApiState",
+        block_size: int = 8,
+        admission_chunk: int | None = None,
+    ):
         self.state = state
         self.engine = state.engine
-        self.block_size = block_size
+        self.block_size = max(1, int(block_size))
+        # admission chunk budget: at most this many prompt tokens prefill
+        # per scheduler tick (0/None = the largest prefill bucket), so the
+        # worst-case inter-token gap an active stream sees is one chunk +
+        # one decode block, never one full prefill
+        self.admission_chunk = (
+            int(admission_chunk)
+            if admission_chunk
+            else max(self.engine.prefill_buckets)
+        )
         self.lanes: list[_LaneState | None] = [None] * self.engine.batch_size
         self.lane_cache = [NaiveCache() for _ in range(self.engine.batch_size)]
         # each lane's final generated token (its KV row is unwritten; it
@@ -188,8 +245,19 @@ class LaneScheduler:
         # when a fresh conversation needs a lane
         self.lane_used: list[int] = [0] * self.engine.batch_size
         self._admission_count = 0
+        # lanes mid-admission (resumable chunked prefill state machine)
+        self.admitting: dict[int, _AdmittingLane] = {}
+        self._rr = -1  # round-robin cursor over concurrently admitting lanes
+        # injectable clock for the stall/prefill accounting (fake-clock
+        # scheduler tests replace it; production uses the monotonic timer)
+        self._clock = time.perf_counter
+        self._last_decode_end: float | None = None
         self.pending: list[LaneJob] = []
         self.cv = threading.Condition()
+        # build the admission-path programs (every prefill bucket + the
+        # decode block) off-thread NOW, so the first admission under load
+        # doesn't pay a synchronous compile stall
+        self.engine.rehearse_admission(self.block_size)
         self.thread = threading.Thread(target=self._loop, daemon=True)
         self.thread.start()
 
@@ -212,10 +280,18 @@ class LaneScheduler:
     def _loop(self) -> None:
         while True:
             with self.cv:
-                while not self.pending and not any(self.lanes):
+                while (
+                    not self.pending
+                    and not any(self.lanes)
+                    and not self.admitting
+                ):
                     self.cv.wait()
                 admissions = []
-                free = [i for i in range(len(self.lanes)) if self.lanes[i] is None]
+                free = [
+                    i
+                    for i in range(len(self.lanes))
+                    if self.lanes[i] is None and i not in self.admitting
+                ]
                 while self.pending and free:
                     job = self.pending.pop(0)
                     # conversation affinity: prefer the free lane whose
@@ -246,7 +322,13 @@ class LaneScheduler:
                     admissions.append((lane, job))
                 self.state.m_queue_depth.set(len(self.pending))
             for lane, job in admissions:
-                self._admit(lane, job)
+                self._begin_admission(lane, job)
+            # stall-free admission: at most ONE bounded prefill chunk per
+            # tick, then a decode block for every active lane — the worst
+            # case inter-token gap is one chunk + one block, and two
+            # pending jobs can never prefill back-to-back while another
+            # lane is mid-stream
+            self._admission_tick()
             if any(self.lanes):
                 try:
                     self._step_block()
@@ -287,16 +369,33 @@ class LaneScheduler:
                                     reason="error"
                                 ).inc()
                             self.lanes[lane] = None
+                        # mid-admission requests sit on the same donated
+                        # cache: their partial prefills are gone too
+                        adm = self.admitting.pop(lane, None)
+                        if adm is not None:
+                            adm.job.events.put(("error", str(e)))
+                            if adm.job.span.finish("error") is not None:
+                                self.state.m_finished.labels(
+                                    reason="error"
+                                ).inc()
                         self.lane_cache[lane].clear()
                         self.lane_pending[lane] = None
                     self._set_lane_gauge()
                     with self.cv:
                         self.cv.notify_all()
+            if not any(self.lanes):
+                # decode went idle: the next dispatch starts a new stall
+                # window, don't charge it for the quiet period
+                self._last_decode_end = None
 
-    def _admit(self, lane: int, job: LaneJob) -> None:
-        state, engine, tok = self.state, self.engine, self.state.tokenizer
+    def _begin_admission(self, lane: int, job: LaneJob) -> None:
+        """Resolve the prompt and park it as an _AdmittingLane — the front
+        half of the old monolithic _admit, with NO engine work: chunks run
+        one per tick in _admission_tick. Validation failures here precede
+        any engine call, so the lane's cached conversation stays intact
+        and reusable, exactly as before."""
+        state, tok = self.state, self.state.tokenizer
         p = job.params
-        engine_touched = False
         try:
             cache = self.lane_cache[lane]
             delta_prompt, start_pos = cache.resolve_delta_prompt(p.messages)
@@ -327,7 +426,7 @@ class LaneScheduler:
                 # belongs at the cache's recorded end position, start_pos
                 tokens = [pending] + tokens
             pos0 = start_pos
-            seq_len = engine.header.seq_len
+            seq_len = self.engine.header.seq_len
             prompt_end = pos0 + len(tokens) - 1
             if prompt_end >= seq_len:
                 raise ValueError(
@@ -339,57 +438,131 @@ class LaneScheduler:
                 if p.max_tokens > 0
                 else seq_len
             )
-            # `seed` is honored PER LANE (r5): decode_lanes derives each
-            # lane's sampling keys from (its seed, its absolute
-            # positions), so a seeded request reproduces regardless of
-            # which other lanes are active or how blocks split.
-            engine_touched = True
-            t0 = time.perf_counter()
-            engine.prefill_lane(lane, tokens, pos0=pos0)
-            pf = time.perf_counter() - t0
-            job.span.set_prefill_seconds(pf)
-            job.span.set_tokens(n_prompt=len(tokens))
-            state.m_prefill.observe(pf)
-            if prompt.public_prompt:
-                job.buffer += prompt.public_prompt
-                job.events.put(("delta", prompt.public_prompt))
             job.n_prompt_tokens = len(tokens)
-            detector = EosDetector(
-                tok.eos_token_ids,
-                state.stops if not p.stop else p.stop,
-                padding_left=state.max_stop_len,
-                padding_right=state.max_stop_len,
-            )
-            self.lanes[lane] = _LaneState(
+            self.admitting[lane] = _AdmittingLane(
                 job=job,
-                pos=prompt_end,
-                token=tokens[-1],
-                max_pos=max_pos,
-                detector=detector,
-                decoder=tok.stream_decoder(),
-                temperature=p.temperature,
-                top_p=p.top_p,
-                seed=p.seed,
-                delta_messages=list(delta_prompt),
+                tokens=tokens,
+                pos0=pos0,
+                cursor=0,
                 prompt_end=prompt_end,
-            )
-            self._set_lane_gauge()
-            state.recorder.record(
-                "admit", lane=lane, reused_prefix_tokens=start_pos,
-                n_prompt=len(tokens),
+                max_pos=max_pos,
+                public_prompt=prompt.public_prompt or "",
+                delta_messages=list(delta_prompt),
+                start_pos=start_pos,
             )
         except Exception as e:
             job.events.put(("error", str(e)))
             if job.span.finish("error") is not None:
                 state.m_finished.labels(reason="error").inc()
-            self.lanes[lane] = None
-            if engine_touched:
-                # the prefill may have partially written this lane's cache
-                if self.lane_cache[lane].items:
-                    self.lane_cache[lane].clear()
-                self.lane_pending[lane] = None
-            # validation errors before any engine call leave the lane's
-            # cached conversation intact and reusable
+
+    def _admission_tick(self) -> None:
+        """Run at most ONE bounded prefill chunk for ONE admitting lane
+        per scheduler tick, round-robin across concurrent admissions, and
+        flip the lane into decode once its last fill token lands."""
+        if not self.admitting:
+            return
+        order = sorted(self.admitting)
+        lane = min((i for i in order if i > self._rr), default=order[0])
+        self._rr = lane
+        adm = self.admitting[lane]
+        job = adm.job
+        if job.cancelled:
+            self._abort_admission(lane, "cancelled")
+            return
+        fills = adm.tokens[:-1]
+        try:
+            if adm.cursor < len(fills):
+                t0 = self._clock()
+                width = self.engine.prefill_lane_chunk(
+                    lane,
+                    fills[adm.cursor:],
+                    adm.pos0 + adm.cursor,
+                    budget=self.admission_chunk,
+                )
+                adm.prefill_s += self._clock() - t0
+                adm.cursor += width
+                adm.n_chunks += 1
+                self.state.m_admission_chunks.inc()
+                self.state.recorder.record(
+                    "admission_chunk", lane=lane, chunk=adm.n_chunks,
+                    pos=adm.pos0 + adm.cursor - width, n_tokens=width,
+                    done=adm.cursor >= len(fills),
+                )
+            if adm.cursor >= len(fills):
+                self._finish_admission(lane, adm)
+        except Exception as e:
+            # a failed chunk releases the lane exactly like the old
+            # monolithic failure path: error the job, and because the
+            # engine was touched, drop this lane's cache + pending token
+            # (the prefill may have partially written it)
+            job.events.put(("error", str(e)))
+            if job.span.finish("error") is not None:
+                self.state.m_finished.labels(reason="error").inc()
+            self.admitting.pop(lane, None)
+            if self.lane_cache[lane].items:
+                self.lane_cache[lane].clear()
+            self.lane_pending[lane] = None
+
+    def _finish_admission(self, lane: int, adm: _AdmittingLane) -> None:
+        """Last fill token landed: install the decode-side _LaneState.
+        `seed` is honored PER LANE (r5): decode_lanes derives each lane's
+        sampling keys from (its seed, its absolute positions), so a seeded
+        request reproduces regardless of which other lanes are active,
+        how blocks split — or how its admission was chunked."""
+        state, tok = self.state, self.state.tokenizer
+        job, p = adm.job, adm.job.params
+        job.span.set_prefill_seconds(adm.prefill_s)
+        job.span.set_tokens(n_prompt=len(adm.tokens))
+        state.m_prefill.observe(adm.prefill_s)
+        if adm.public_prompt:
+            job.buffer += adm.public_prompt
+            job.events.put(("delta", adm.public_prompt))
+        detector = EosDetector(
+            tok.eos_token_ids,
+            state.stops if not p.stop else p.stop,
+            padding_left=state.max_stop_len,
+            padding_right=state.max_stop_len,
+        )
+        self.lanes[lane] = _LaneState(
+            job=job,
+            pos=adm.prompt_end,
+            token=adm.tokens[-1],
+            max_pos=adm.max_pos,
+            detector=detector,
+            decoder=tok.stream_decoder(),
+            temperature=p.temperature,
+            top_p=p.top_p,
+            seed=p.seed,
+            delta_messages=adm.delta_messages,
+            prompt_end=adm.prompt_end,
+        )
+        del self.admitting[lane]
+        self._set_lane_gauge()
+        state.recorder.record(
+            "admit", lane=lane, reused_prefix_tokens=adm.start_pos,
+            n_prompt=len(adm.tokens), n_chunks=adm.n_chunks,
+        )
+
+    def _abort_admission(self, lane: int, reason: str) -> None:
+        """Client went away mid-admission: stop prefilling for nobody."""
+        adm = self.admitting.pop(lane)
+        job = adm.job
+        if job.span.finish(
+            reason, n_prompt=len(adm.tokens), n_completion=0
+        ) is not None:
+            self.state.m_finished.labels(reason=reason).inc()
+            if reason == "cancelled":
+                self.state.m_cancellations.inc()
+        job.events.put(("done", reason))
+        if adm.cursor > 0:
+            # partially prefilled KV no longer matches a recordable
+            # conversation (same rule as a cancelled decode in _finish)
+            self.lane_cache[lane].clear()
+            self.lane_pending[lane] = None
+        self.state.recorder.record(
+            "finish", lane=lane, reason=reason, pos=adm.pos0 + adm.cursor,
+            n_completion=0,
+        )
 
     def _finish(self, lane: int, reason: str) -> None:
         ls = self.lanes[lane]
@@ -442,10 +615,18 @@ class LaneScheduler:
         temps = [ls.temperature if ls else 0.0 for ls in self.lanes]
         topps = [ls.top_p if ls else 1.0 for ls in self.lanes]
         seeds = [ls.seed if ls else None for ls in self.lanes]
+        # decode stall: the gap since the previous decode-block dispatch
+        # finished, while >=1 lane was active the whole time — whatever sat
+        # in between (admission chunks, host work) is latency a streaming
+        # client ate. Chunked admission bounds it by one chunk + one block.
+        now = self._clock()
+        if self._last_decode_end is not None:
+            self.state.m_decode_stall.observe(now - self._last_decode_end)
         t0 = time.perf_counter()
         rows = self.engine.decode_lanes(
             tokens, pos, self.block_size, active, temps, topps, seeds=seeds
         )
+        self._last_decode_end = self._clock()
         if rows:
             # every active stream advanced len(rows) tokens in this block
             self.state.m_tpot.observe(
@@ -495,6 +676,8 @@ class ApiState:
         model_name: str = "dllama-tpu",
         chat_template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
         tracer: Tracer | None = None,
+        lane_block_size: int = 8,
+        admission_chunk: int | None = None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
@@ -585,6 +768,17 @@ class ApiState:
             "Engine errors swallowed by the lane-scheduler loop (each one "
             "dropped every in-flight lane; see the traceback log).",
         )
+        self.m_admission_chunks = self.obs.counter(
+            "dllama_admission_chunks_total",
+            "Bounded prefill chunks dispatched by the chunked admission "
+            "state machine (one per scheduler tick per admitting lane).",
+        )
+        self.m_decode_stall = self.obs.histogram(
+            "dllama_decode_stall_seconds",
+            "Gap between consecutive decode-block dispatches while >=1 "
+            "lane is active — the inter-token stall streaming clients "
+            "see; bounded by one admission chunk + one block.",
+        )
         # request defaults captured once: per-request sampler mutations must
         # not leak into later requests' defaults
         self.default_temperature = engine.temperature
@@ -605,7 +799,12 @@ class ApiState:
         # engine's batch lanes (the reference's accept loop — and the
         # batch_size == 1 path here — serves one request at a time)
         self.scheduler = (
-            LaneScheduler(self) if engine.batch_size > 1 and engine.sp == 1
+            LaneScheduler(
+                self,
+                block_size=lane_block_size,
+                admission_chunk=admission_chunk,
+            )
+            if engine.batch_size > 1 and engine.sp == 1
             else None
         )
         self.m_lanes_total.set(
@@ -1173,13 +1372,18 @@ def serve(
     chat_template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
     trace_out: str | None = None,
     postmortem_dir: str | None = None,
+    lane_block_size: int | None = None,
+    admission_chunk: int | None = None,
 ):
+    block, chunk = resolve_lane_knobs(lane_block_size, admission_chunk)
     state = ApiState(
         engine,
         tokenizer,
         model_name,
         chat_template_type,
         tracer=Tracer(sink_path=trace_out) if trace_out else None,
+        lane_block_size=block,
+        admission_chunk=chunk,
     )
     if postmortem_dir:
         # a crashed scheduler loop / engine step dumps the event ring here
@@ -1235,6 +1439,8 @@ def main(argv=None) -> None:
                 chat_template_type=ttype,
                 trace_out=args.trace_out,
                 postmortem_dir=args.postmortem_dir,
+                lane_block_size=args.lane_block_size,
+                admission_chunk=args.admission_chunk,
             )
             server.serve_forever()
             return

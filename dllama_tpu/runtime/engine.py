@@ -927,12 +927,42 @@ class InferenceEngine:
                 "(lanes park their writes in cache padding rows)"
             )
 
-    def _lane_prefill_fn(self, t: int, window: int = 0):
+    def _lane_prefill_arg_specs(self, t: int):
+        """Arg specs for a lane-prefill chunk dispatch (the AOT lowering
+        input): token rows are (lanes, bucket) with the lane sharding, the
+        position vector is per-lane, and the params/cache trees come from
+        the init-time snapshot (same no-donated-reads rule as
+        _lane_arg_specs — rehearsal threads must never read live trees a
+        serving dispatch is donating)."""
+        b = self.batch_size
+        tok = jax.ShapeDtypeStruct(
+            (b, t), jnp.int32, sharding=self._token_sharding
+        )
+        return (
+            self._param_specs,
+            tok,
+            self._cache_specs,
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+        )
+
+    def _lane_prefill_fn(
+        self, t: int, window: int = 0, origin: str = "dispatch"
+    ):
         """Vector-position prefill step: each lane writes its chunk at its
-        own position; parked lanes write into the padding rows."""
+        own position; parked lanes write into the padding rows.
+        AOT-compiled like the decode blocks — this is the lane scheduler's
+        ADMISSION path, so a synchronous XLA compile here is exactly the
+        first-admission stall rehearse_admission() exists to remove."""
         key = ("lane_prefill", t, window)
-        if key in self._compiled:
-            return self._compiled[key]
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
+            ev = self._inflight.get(key) if origin == "dispatch" else None
+        if ev is not None:  # a rehearsal thread is building it: wait, reuse
+            ev.wait()
+            with self._compile_lock:
+                if key in self._compiled:
+                    return self._compiled[key]
         precision = self._precision
         fwd = self._fwd
         park = self._park
@@ -952,13 +982,106 @@ class InferenceEngine:
                 )
             return cache
 
-        self._compiled[key] = step
-        self._compile_origin[key] = "dispatch"
-        self._m_compiles.labels(origin="dispatch").inc()
+        self.recorder.record("compile_start", key=str(key), origin=origin)
+        t0 = time.perf_counter()
+        if self._aot_blocks:
+            step = step.lower(*self._lane_prefill_arg_specs(t)).compile()
+        dt = time.perf_counter() - t0
+        with self._compile_lock:
+            self._compiled[key] = step
+            self._compile_origin[key] = origin
+            if self._aot_blocks:
+                self._compile_seconds[key] = dt
+        self._m_compiles.labels(origin=origin).inc()
         self.recorder.record(
-            "compile", key=str(key), origin="dispatch", deferred=True
+            "compile_end", key=str(key), origin=origin, s=round(dt, 4)
         )
         return step
+
+    def rehearse_admission(self, block_size: int | None = None) -> None:
+        """Pre-compile the admission-path programs in the background: one
+        lane-prefill chunk program per configured bucket (at the bucket's
+        base attention window) plus the lane decode block, so the FIRST
+        admission under load finds them in the cache instead of paying a
+        synchronous compile stall on the serving path. No-op without AOT
+        blocks (DLLAMA_WINDOW_PRECOMPILE=0): the lazily jitted programs
+        then compile at first dispatch as before."""
+        self._require_lanes()
+        if not self._aot_blocks:
+            return
+        for bucket in self.prefill_buckets:
+            window = self._attn_window(bucket)
+            self._prefetch(
+                ("lane_prefill", bucket, window),
+                lambda b=bucket, w=window: self._lane_prefill_fn(
+                    b, window=w, origin="prefetch"
+                ),
+            )
+        if block_size:
+            window = self._attn_window(block_size)
+            self._prefetch(
+                ("lane_block", block_size, window),
+                lambda n=block_size, w=window: self._lane_decode_fn(
+                    n, w, origin="prefetch"
+                ),
+            )
+
+    def prefill_lane_chunk(
+        self,
+        lane: int,
+        tokens: list[int],
+        pos0: int,
+        budget: int | None = None,
+    ) -> int:
+        """Write ONE bucket-shaped chunk of `tokens` (fill rows — the
+        caller already dropped the prompt's final token) into `lane`'s
+        cache at `pos0`; returns how many tokens were consumed. This is
+        the resumable half of prefill_lane: the lane scheduler dispatches
+        one chunk per loop tick so a long prompt's admission interleaves
+        with decode blocks instead of freezing every active lane for the
+        whole prefill. `budget` caps the chunk width (--admission-chunk).
+        Chunks reuse the same _lane_prefill_fn bucket programs as the
+        monolithic path — no new compiled shapes — and write the same KV
+        rows, so chunked admission is token-exact vs monolithic."""
+        self._require_lanes()
+        if not 0 <= lane < self.batch_size:
+            raise ValueError(f"lane {lane} out of range")
+        n = len(tokens)
+        if n < 1:
+            raise ValueError("empty chunk")
+        if pos0 + n > self.header.seq_len:
+            raise ValueError(
+                f"{n} fill tokens at pos {pos0} exceed "
+                f"seqLen {self.header.seq_len}"
+            )
+        want = min(n, budget) if budget and budget > 0 else n
+        bucket = self._bucket_for(want, pos0)
+        width = min(bucket, want)
+        chunk = tokens[:width] + [0] * (bucket - width)
+        rows = [[0] * bucket for _ in range(self.batch_size)]
+        rows[lane] = chunk
+        posv = [self._park] * self.batch_size
+        posv[lane] = pos0
+        window = self._attn_window(pos0 + bucket)
+        step = self._lane_prefill_fn(bucket, window=window)
+        self.recorder.record(
+            "step_dispatch", step="prefill_lane_chunk", lane=lane, pos=pos0,
+            n_tokens=width, bucket=bucket, window=window,
+        )
+        t0 = time.perf_counter()
+        arr = jax.device_put(
+            jnp.asarray(rows, jnp.int32), self._token_sharding
+        )
+        pos_arr = jnp.asarray(posv, jnp.int32)
+        with self._cache_guard():
+            self.cache = step(self.params, arr, self.cache, pos_arr)
+        dt = time.perf_counter() - t0
+        self._m_step.labels(kind="prefill_lane_chunk").observe(dt)
+        self.recorder.record(
+            "step_complete", step="prefill_lane_chunk", lane=lane, pos=pos0,
+            n_tokens=width, ms=round(dt * 1000, 3),
+        )
+        return width
 
     def prefill_lane(self, lane: int, tokens: list[int], pos0: int = 0) -> None:
         """Prefill one lane's prompt (all but the last token) while every
@@ -967,7 +1090,9 @@ class InferenceEngine:
         from every real query. This is what lets the API server admit a
         new request into a free lane while other lanes hold live
         conversations (the reference's single-stream loop has no
-        equivalent)."""
+        equivalent). Runs the chunks back-to-back; the lane scheduler
+        instead calls prefill_lane_chunk directly to interleave them with
+        decode blocks."""
         self._require_lanes()
         if not 0 <= lane < self.batch_size:
             raise ValueError(f"lane {lane} out of range")
@@ -987,23 +1112,8 @@ class InferenceEngine:
         )
         t0 = time.perf_counter()
         while fills:
-            bucket = self._bucket_for(len(fills), p)
-            width = min(bucket, len(fills))
-            chunk = fills[:width] + [0] * (bucket - width)
+            width = self.prefill_lane_chunk(lane, fills, p)
             fills = fills[width:]
-            rows = [[0] * bucket for _ in range(self.batch_size)]
-            rows[lane] = chunk
-            posv = [self._park] * self.batch_size
-            posv[lane] = p
-            arr = jax.device_put(
-                jnp.asarray(rows, jnp.int32), self._token_sharding
-            )
-            pos_arr = jnp.asarray(posv, jnp.int32)
-            step = self._lane_prefill_fn(
-                bucket, window=self._attn_window(p + bucket)
-            )
-            with self._cache_guard():
-                self.cache = step(self.params, arr, self.cache, pos_arr)
             p += width
         if p > pos0:
             dt = time.perf_counter() - t0
