@@ -373,8 +373,112 @@ def _serving_smoke(n_clients: int) -> dict:
     for t in churners + [vt]:
         t.join()
 
+    # shared-system-prompt fanout (ISSUE 6): N streams share one long
+    # system prompt. A warmup request publishes the rendered prefix into
+    # the radix tree at finish; the fanned-out streams then admit with
+    # most of their prompt ADOPTED from shared pool pages instead of
+    # re-prefilled. The same round runs against a sharing-OFF server
+    # (kv_page_size=-1) so the TTFT delta is the sharing win, not noise
+    # between configs.
+    fanout_n = max(3, n_clients)
+    sys_prompt = (
+        "You are a terse assistant. Answer in one short sentence and "
+        "never repeat the question back to the user. "
+    )
+
+    def fanout_round(port_: int) -> float | None:
+        def one(i: int, out: dict) -> None:
+            conn = http.client.HTTPConnection("127.0.0.1", port_, timeout=300)
+            t0 = time.perf_counter()
+            conn.request(
+                "POST", "/v1/chat/completions",
+                json.dumps({
+                    "messages": [
+                        {"role": "system", "content": sys_prompt},
+                        {"role": "user", "content": f"q{i}"},
+                    ],
+                    "max_tokens": 4, "stream": True,
+                }),
+                {"Content-Type": "application/json"},
+            )
+            r = conn.getresponse()
+            ttft = None
+            while True:
+                line = r.readline()
+                if not line or b"[DONE]" in line:
+                    break
+                if line.startswith(b"data:") and ttft is None:
+                    ttft = time.perf_counter() - t0
+            conn.close()
+            out[i] = ttft
+
+        warm: dict = {}
+        one(0, warm)  # publishes the shared prefix; not timed
+        outs: dict = {}
+        ths = [
+            threading.Thread(target=one, args=(i, outs))
+            for i in range(1, fanout_n + 1)
+        ]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        vals = sorted(v * 1000 for v in outs.values() if v is not None)
+        return round(vals[len(vals) // 2], 2) if vals else None
+
+    fan_t0 = time.time()
+    pre_fan = scrape_metrics()
+    ttft_on = fanout_round(port)
+    post_fan = scrape_metrics()
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    c.request("GET", "/v1/debug/kv")
+    kv_dbg = json.loads(c.getresponse().read().decode("utf-8"))
+    c.close()
+
     metrics_text = scrape_metrics()
     srv.shutdown()
+
+    # sharing-off baseline: fresh engine + server with the pool disabled
+    # (a second server so the on-run's radix state cannot leak in)
+    engine_off = InferenceEngine(
+        model_path, tokenizer=tok, batch_size=n_lanes, temperature=0.0
+    )
+    srv_off = serve(
+        engine_off, tok, host="127.0.0.1", port=0, admission_chunk=32,
+        kv_page_size=-1,
+    )
+    port_off = srv_off.server_address[1]
+    threading.Thread(target=srv_off.serve_forever, daemon=True).start()
+    ttft_off = fanout_round(port_off)
+    srv_off.shutdown()
+
+    fan_recs = [
+        r for r in read_jsonl(trace_path)
+        if r.get("submitted_unix", 0) >= fan_t0
+        and r.get("reused_prefix_tokens") and r.get("n_prompt_tokens")
+    ]
+    prefix_fanout = {
+        "n_streams": fanout_n,
+        "n_reused_streams": len(fan_recs),
+        "shared_prefix_ratio": round(
+            max(
+                (r["reused_prefix_tokens"] / r["n_prompt_tokens"]
+                 for r in fan_recs),
+                default=0.0,
+            ), 3,
+        ),
+        "reused_tokens_total": int(
+            metric_value(post_fan, "dllama_reused_prefix_tokens_total")
+            - metric_value(pre_fan, "dllama_reused_prefix_tokens_total")
+        ),
+        "radix_hits": int(
+            metric_value(post_fan, "dllama_radix_hits_total")
+            - metric_value(pre_fan, "dllama_radix_hits_total")
+        ),
+        "ttft_ms_p50_sharing_on": ttft_on,
+        "ttft_ms_p50_sharing_off": ttft_off,
+        "kv_pool": kv_dbg.get("pool"),
+    }
 
     def hist_count(name: str) -> int:
         m = re.search(rf"^{name}_count (\d+)", metrics_text, re.M)
@@ -451,6 +555,7 @@ def _serving_smoke(n_clients: int) -> dict:
         "decode_stall_sum_s": round(
             metric_value(metrics_text, "dllama_decode_stall_seconds_sum"), 4
         ),
+        "prefix_fanout": prefix_fanout,
         "obs_overhead_pct": round(overhead_pct, 2),
     }
 

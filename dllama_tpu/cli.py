@@ -53,6 +53,17 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                         "env DLLAMA_LANE_BLOCK, else 8) — with "
                         "--admission-chunk this bounds the worst-case "
                         "inter-token gap at one chunk + one block")
+    p.add_argument("--kv-page-size", type=int, default=None,
+                   dest="kv_page_size", metavar="TOKENS",
+                   help="paged-KV pool page size for cross-lane prefix "
+                        "sharing on the lane-scheduler path (default: env "
+                        "DLLAMA_KV_PAGE_SIZE, else 16); negative disables "
+                        "the shared pool entirely (no prefix reuse)")
+    p.add_argument("--kv-pool-pages", type=int, default=None,
+                   dest="kv_pool_pages", metavar="N",
+                   help="pages in the shared KV pool (default: env "
+                        "DLLAMA_KV_POOL_PAGES, else auto: two sequences' "
+                        "worth, 2*seqLen/pageSize + 1)")
     p.add_argument("--admission-chunk", type=int, default=None,
                    dest="admission_chunk", metavar="TOKENS",
                    help="max prompt tokens prefilled per scheduler tick "

@@ -1,0 +1,12 @@
+"""Paged KV subsystem: fixed-size page pool + radix prefix tree.
+
+``pool``   — host-side page accounting (refcounts, free list, COW forks).
+``radix``  — prefix tree over token IDs mapping shared prefixes to page slots.
+``manager``— glue between the pool/tree, the engine's device page buffer, and
+             the lane scheduler (adopt at admission, publish at finish).
+"""
+
+from dllama_tpu.kv.pool import PagePool, PoolStats
+from dllama_tpu.kv.radix import MatchResult, RadixTree
+
+__all__ = ["PagePool", "PoolStats", "RadixTree", "MatchResult"]

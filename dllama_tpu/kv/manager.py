@@ -1,0 +1,254 @@
+"""PagedKVManager: glue between the page pool / radix tree and the engine.
+
+Owns the host-side accounting for the engine's device page pool and the
+serving-path policy around it:
+
+- ``match``   — at admission, find the longest stored token prefix of the
+  request's (fully retokenized) conversation and the pages covering it;
+- ``adopt``   — copy those pages into the admitted lane's slab (one
+  bucketed device gather) and retain them for the lane's lifetime;
+- ``publish`` — at finish, store the lane's fed tokens' whole pages back
+  into the pool, deduplicating against the tree so a prefix two streams
+  share is physically stored ONCE (the second publisher allocates pages
+  only for its unshared suffix, forking copy-on-write at a mid-page
+  divergence);
+- ``release_lane`` / ``reset`` — refcount hygiene and the error path.
+
+All engine calls are made by the scheduler thread; ``lock`` only protects
+the host-side accounting against concurrent /v1/debug/kv and /metrics
+readers.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..obs.metrics import get_registry
+from ..obs.recorder import get_recorder
+from .pool import PagePool
+from .radix import RadixTree
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PAGE_SIZE = 16
+
+
+class PagedKVManager:
+    def __init__(
+        self,
+        engine,
+        page_size: int = 0,
+        n_pages: int = 0,
+        evict_counter=None,
+    ):
+        self.engine = engine
+        self.page_size = page_size or DEFAULT_PAGE_SIZE
+        n = engine.init_kv_pool(self.page_size, n_pages)
+        self.recorder = get_recorder()
+        self.pool = PagePool(n, self.page_size, on_event=self._pool_event)
+        self.tree = RadixTree(self.page_size)
+        self.lock = threading.Lock()
+        self._lane_pages: dict[int, list[int]] = {}
+        # dashboards keep their dllama_cache_evictions_total series: the
+        # ApiState hands us its handle and radix evictions feed it
+        self._evict_counter = evict_counter
+        obs = get_registry()
+        self.g_total = obs.gauge(
+            "dllama_kv_pages_total",
+            "Usable pages in the shared KV pool (excludes the scratch page).",
+        )
+        self.g_free = obs.gauge(
+            "dllama_kv_pages_free", "KV pool pages on the free list."
+        )
+        self.g_shared = obs.gauge(
+            "dllama_kv_pages_shared",
+            "KV pool pages referenced by the radix tree AND at least one "
+            "live lane (refcount >= 2) — the physically-shared prefix "
+            "storage.",
+        )
+        self.c_hits = obs.counter(
+            "dllama_radix_hits_total",
+            "Admissions whose conversation matched a stored radix prefix "
+            "and adopted shared pages.",
+        )
+        self.c_evictions = obs.counter(
+            "dllama_radix_evictions_total",
+            "Pages LRU-evicted from radix-tree leaves to make room for a "
+            "publish.",
+        )
+        self.c_shared_tokens = obs.counter(
+            "dllama_shared_prefix_tokens_total",
+            "Prompt tokens served from shared pool pages instead of being "
+            "re-prefilled (sum of adopted prefix lengths).",
+        )
+        self.c_cow = obs.counter(
+            "dllama_kv_cow_forks_total",
+            "Copy-on-write page forks: a publish diverged mid-page from a "
+            "stored prefix and took a private copy of that page slot.",
+        )
+        self.g_total.set(n - 1)
+        self._update_gauges_locked()
+
+    # -- internals ---------------------------------------------------------
+    def _pool_event(self, kind: str, payload: dict) -> None:
+        self.recorder.record(kind, **payload)
+        if kind == "kv_cow_fork":
+            self.c_cow.inc()
+
+    def _update_gauges_locked(self) -> None:
+        st = self.pool.stats()
+        self.g_free.set(st.free)
+        self.g_shared.set(st.shared)
+
+    # -- admission ---------------------------------------------------------
+    def match(self, tokens: list[int]) -> tuple[int, list[int]]:
+        """Longest reusable stored prefix of ``tokens``: returns
+        ``(n_reused_tokens, pages)``. Reuse is capped one short of the
+        prompt (the engine must be fed at least one token) and to the
+        rows the collected pages actually cover; a partial final page is
+        fine (its stale tail rows are overwritten by suffix prefill
+        before any query can attend to them)."""
+        ps = self.page_size
+        with self.lock:
+            mr = self.tree.match(tokens)
+            m = min(mr.n_tokens, len(mr.pages) * ps, len(tokens) - 1)
+            if m <= 0:
+                return 0, []
+            n_pages = -(-m // ps)  # ceil
+            return m, mr.pages[:n_pages]
+
+    def adopt(self, lane: int, pages: list[int]) -> None:
+        """Device-copy ``pages`` into ``lane``'s slab and retain them for
+        the lane's lifetime (retained pages cannot be evicted out from
+        under a live stream)."""
+        self.engine.kv_adopt(lane, pages)
+        with self.lock:
+            self.pool.retain(pages)
+            # a lane admitted twice without release would leak a retain
+            stale = self._lane_pages.pop(lane, None)
+            if stale:
+                self.pool.release(stale)
+            self._lane_pages[lane] = list(pages)
+            self._update_gauges_locked()
+
+    def release_lane(self, lane: int) -> None:
+        with self.lock:
+            pages = self._lane_pages.pop(lane, None)
+            if pages:
+                self.pool.release(pages)
+            self._update_gauges_locked()
+
+    # -- finish ------------------------------------------------------------
+    def publish(self, lane: int, tokens: list[int]) -> int:
+        """Store ``lane``'s fed ``tokens`` (KV rows [0, len(tokens)) are
+        live in its slab) as whole pages. Dedups against the tree first:
+        slots the tree already holds are NOT copied again — that is what
+        makes a fanned-out system prompt physically one set of pages.
+        Returns the number of pages newly stored (0 = full dedup or no
+        whole page to store)."""
+        ps = self.page_size
+        n_full = len(tokens) // ps
+        if n_full == 0:
+            return 0
+        full = list(tokens[: n_full * ps])
+        with self.lock:
+            mr = self.tree.match(full)
+            k_shared = min(mr.n_tokens // ps, n_full)
+            n_new = n_full - k_shared
+            if n_new == 0:
+                return 0
+            short = n_new - self.pool.free_pages
+            if short > 0:
+                freed = self.tree.evict(short, self.pool)
+                self.c_evictions.inc(freed)
+                if self._evict_counter is not None:
+                    self._evict_counter.inc(freed)
+                if freed:
+                    self.recorder.record("kv_evict", n_pages=freed, lane=lane)
+            if n_new > self.pool.free_pages:
+                # pool is full of retained/live pages: skip publishing
+                # rather than stall (the stream already served; only future
+                # reuse is lost)
+                self.recorder.record(
+                    "kv_publish_skipped", lane=lane, want=n_new,
+                    free=self.pool.free_pages,
+                )
+                return 0
+            diverged_mid_page = (
+                mr.n_tokens > k_shared * ps and len(mr.pages) > k_shared
+            )
+            if diverged_mid_page:
+                pages = [self.pool.fork(mr.pages[k_shared])]
+                pages += self.pool.alloc(n_new - 1)
+            else:
+                pages = self.pool.alloc(n_new)
+        try:
+            self.engine.kv_publish(lane, pages, start_page=k_shared)
+        except BaseException:
+            # the publish program donates the pool buffer: device contents
+            # are unknown, so drop ALL host accounting with it (the engine
+            # guard already rebuilt the buffer)
+            logger.exception("kv_publish failed; resetting the page pool")
+            self.reset(reset_device=False)
+            return 0
+        with self.lock:
+            self.tree.insert(full, pages, first_slot=k_shared)
+            self._update_gauges_locked()
+        return n_new
+
+    def note_hit(self, n_tokens: int) -> None:
+        self.c_hits.inc()
+        self.c_shared_tokens.inc(n_tokens)
+
+    # -- error path / introspection ----------------------------------------
+    def reset(self, reset_device: bool = True) -> None:
+        """Drop every page and stored prefix (host and, by default, the
+        device buffer) — the big hammer for engine-error recovery paths
+        that cannot trust pool contents."""
+        with self.lock:
+            self.tree.clear()
+            self.pool.reset()
+            self._lane_pages.clear()
+            self._update_gauges_locked()
+        if reset_device:
+            self.engine.reset_kv_pool()
+        self.recorder.record("kv_pool_reset")
+
+    def release_all_lanes(self) -> None:
+        """Scheduler-error path: every lane was dropped, release their
+        retains. Pool pages themselves are NOT donated by decode/prefill
+        dispatches, so the tree's stored prefixes stay valid."""
+        with self.lock:
+            for pages in self._lane_pages.values():
+                self.pool.release(pages)
+            self._lane_pages.clear()
+            self._update_gauges_locked()
+
+    def check(self) -> None:
+        with self.lock:
+            self.pool.check()
+
+    def debug(self) -> dict:
+        """The /v1/debug/kv payload."""
+        with self.lock:
+            st = self.pool.stats()
+            return {
+                "page_size": self.page_size,
+                "pool": {
+                    "total": st.total,
+                    "free": st.free,
+                    "used": st.used,
+                    "shared": st.shared,
+                    "cow_forks": st.cow_forks,
+                },
+                "radix": {
+                    "nodes": self.tree.node_count(),
+                    "tokens": self.tree.token_count(),
+                    "pages": self.tree.n_pages,
+                },
+                "lanes": {
+                    str(lane): len(pages)
+                    for lane, pages in self._lane_pages.items()
+                },
+            }

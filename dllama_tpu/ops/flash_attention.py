@@ -552,6 +552,173 @@ def flash_decode_stats(
     )
 
 
+def _paged_decode_kernel(
+    pos_ref,  # SMEM scalar prefetch: [B] int32 per-lane query positions
+    pt_ref,  # SMEM scalar prefetch: [B, n_blocks] int32 page table
+    q_ref,  # [1, G, hd]
+    k_ref,  # [1, 1, ps, hd] — one PAGE of one head, via page-table lookup
+    v_ref,  # [1, 1, ps, hd]
+    *rest,  # quant_kv: (ks_ref [1,1,ps,1], vs_ref [1,1,ps,1]); then
+    #         o_ref [1, G, hd] and scratch (m_ref, l_ref, acc_ref)
+    page_size: int,
+    n_blocks: int,
+    n_kv_heads: int,
+    scale: float,
+    quant_kv: bool = False,
+):
+    """T=1 decode over a PAGED pool: identical online-softmax body to
+    _flash_decode_kernel, but the kv tiles arrive through the page table
+    (the index map below) instead of a contiguous per-lane slab, so logical
+    block ``si`` of lane ``b`` reads physical page ``pt_ref[b, si]``. A lane
+    whose prefix is shared never holds its own copy of those rows."""
+    if quant_kv:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    si = pl.program_id(1)
+    pos = pos_ref[pl.program_id(0) // n_kv_heads]
+
+    @pl.when(si == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    s_start = si * page_size
+
+    @pl.when(s_start <= pos)
+    def _compute():
+        g = q_ref.shape[1]
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        if quant_kv:
+            k = k * ks_ref[0, 0]
+        scores = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        s_row = s_start + jax.lax.broadcasted_iota(jnp.int32, (g, page_size), 1)
+        scores = jnp.where(s_row <= pos, scores, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(scores, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        p = jnp.where(m_new <= _NEG_INF / 2, 0.0, p)
+        alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)
+        if quant_kv:
+            v = v * vs_ref[0, 0]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(si == n_blocks - 1)
+    def _emit():
+        l_safe = jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_flash_decode(
+    q: jnp.ndarray,  # [B, 1, H, hd]
+    k_pages,  # [P, KH, ps, hd] pool leaf (or QuantKV pair)
+    v_pages,
+    page_table: jnp.ndarray,  # [B, n_blocks] int32 physical page per logical block
+    pos: jnp.ndarray,  # scalar int32, or [B] per-lane positions
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Single-token causal GQA attention reading KV through a page table.
+
+    This is the page-indirection seam over the flash decode kernel: the kv
+    BlockSpec index map resolves logical block ``si`` of lane ``b`` to
+    physical pool page ``page_table[b, si]`` (clamped at the lane's causal
+    frontier, padding entries point at the reserved scratch page), so lanes
+    sharing a prefix read the SAME physical pages — storage is per unique
+    prefix, not per lane. Accepts a QuantKV pool (int8 values + per-row
+    scales ride the same index map; dequant on the VMEM tile).
+
+    Block length equals the pool's page size. On real Mosaic the same
+    caveats as _flash_decode_kernel apply (repeated-index DMAs are not
+    elided, and tiny pages under-utilize the (8, 128) tile), so the engine
+    keeps windowed dense attention on the decode hot path; this kernel is
+    the op-level paged surface, exercised interpret-mode in tests and ready
+    for silicon page-size tuning (page_size a multiple of 8 f32 / 16 bf16,
+    head_dim a multiple of 128)."""
+    quant_kv = isinstance(k_pages, QuantKV)
+    if isinstance(v_pages, QuantKV) != quant_kv:
+        raise TypeError(
+            f"k_pages and v_pages must both be QuantKV or both dense, got "
+            f"k={type(k_pages).__name__}, v={type(v_pages).__name__}"
+        )
+    b, t, h, hd = q.shape
+    assert t == 1, "paged_flash_decode is the T=1 path"
+    kh, ps = k_pages.shape[1], k_pages.shape[2]
+    g = h // kh
+    n_blocks = page_table.shape[1]
+    scale = 1.0 / (hd**0.5)
+
+    qt = q.reshape(b, kh, g, hd).reshape(b * kh, g, hd)
+    pos_arr = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(pos, jnp.int32)), (b,))
+    pt = page_table.astype(jnp.int32)
+
+    def q_map(bk, si, pos_ref, pt_ref):
+        return (bk, 0, 0)
+
+    def kv_map(bk, si, pos_ref, pt_ref):
+        # page-table indirection with the usual causal-frontier clamp:
+        # blocks past the lane's position re-fetch the frontier page
+        # (compute skipped); clamping also keeps padding page-table slots
+        # (scratch page 0) from ever being DMA'd beyond the frontier
+        lane = bk // kh
+        limit = jnp.maximum(pos_ref[lane], 0) // ps
+        return (pt_ref[lane, jnp.minimum(si, limit)], bk % kh, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, g, hd), q_map),
+        pl.BlockSpec((1, 1, ps, hd), kv_map),
+        pl.BlockSpec((1, 1, ps, hd), kv_map),
+    ]
+    operands = [qt, k_pages, v_pages]
+    if quant_kv:
+        in_specs += [
+            pl.BlockSpec((1, 1, ps, 1), kv_map),
+            pl.BlockSpec((1, 1, ps, 1), kv_map),
+        ]
+        operands = [qt, k_pages.q, v_pages.q, k_pages.s, v_pages.s]
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel,
+            page_size=ps,
+            n_blocks=n_blocks,
+            n_kv_heads=kh,
+            scale=scale,
+            quant_kv=quant_kv,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b * kh, n_blocks),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, g, hd), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((g, 128), jnp.float32),
+                pltpu.VMEM((g, 128), jnp.float32),
+                pltpu.VMEM((g, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * kh, g, hd), jnp.float32),
+        interpret=interpret,
+    )(pos_arr, pt, *operands)
+    return out.reshape(b, kh, g, hd).reshape(b, 1, h, hd).astype(q.dtype)
+
+
 def flash_attention(
     q: jnp.ndarray,  # [B, T, H, hd]
     k_cache: jnp.ndarray,  # [B, KH, S, hd]

@@ -67,3 +67,42 @@ def slice_kv(cache_l, w: int):
     if isinstance(cache_l, QuantKV):
         return QuantKV(cache_l.q[:, :, :w], cache_l.s[:, :, :w])
     return cache_l[:, :, :w]
+
+
+def gather_pages(pool_l, page_ids):
+    """Contiguous read view of a paged pool leaf.
+
+    ``pool_l`` [P, KH, ps, hd] (one layer of the engine's page pool, or a
+    QuantKV pair of [P, KH, ps, hd] values + [P, KH, ps, 1] scales) and
+    ``page_ids`` [n] int32 -> [KH, n*ps, hd] rows in page order, the
+    head-major layout every attention path consumes."""
+    if isinstance(pool_l, QuantKV):
+        return QuantKV(
+            gather_pages(pool_l.q, page_ids), gather_pages(pool_l.s, page_ids)
+        )
+    pages = pool_l[page_ids]  # [n, KH, ps, last]
+    n, kh, ps, last = pages.shape
+    return pages.transpose(1, 0, 2, 3).reshape(kh, n * ps, last)
+
+
+def scatter_pages(pool_l, page_ids, rows):
+    """Write contiguous rows back into pool pages (inverse of
+    :func:`gather_pages`): ``rows`` [KH, n*ps, hd] lands in ``pool_l``
+    [P, KH, ps, hd] at ``page_ids`` [n]. QuantKV-aware on both sides."""
+    if isinstance(pool_l, QuantKV):
+        return QuantKV(
+            scatter_pages(pool_l.q, page_ids, rows.q),
+            scatter_pages(pool_l.s, page_ids, rows.s),
+        )
+    kh, _, last = rows.shape
+    ps = pool_l.shape[2]
+    n = page_ids.shape[0]
+    pages = rows.reshape(kh, n, ps, last).transpose(1, 0, 2, 3)
+    return pool_l.at[page_ids].set(pages.astype(pool_l.dtype))
+
+
+def paged_view(pool_l, page_ids, dtype):
+    """Dense [KH, n*ps, hd] view of the given pages, dequantized when the
+    pool stores QuantKV — the read path for code that wants contiguous
+    rows without caring how the pool stores them."""
+    return dequant_kv(gather_pages(pool_l, page_ids), dtype)

@@ -9,9 +9,11 @@ Capability port of the reference's `dllama-api` (src/dllama-api.cpp):
 * ``GET /metrics`` — Prometheus text exposition of the serving/engine
   metrics (obs/metrics.py; see docs/serving_metrics.md);
 * ``GET /v1/health`` — model name, lane occupancy, queue depth, uptime;
-* **NaiveCache** — KV positions are reused when a new request's messages
-  are a strict superset of the previous conversation
-  (src/dllama-api.cpp:298-343).
+* **NaiveCache** — on the serialized (batch_size == 1) path, KV positions
+  are reused when a new request's messages are a strict superset of the
+  previous conversation (src/dllama-api.cpp:298-343);
+* ``GET /v1/debug/kv`` — paged-KV pool / radix-tree introspection
+  (lane-scheduler path).
 
 The reference hand-rolls an HTTP/1.1 server over raw sockets; here Python's
 stdlib ThreadingHTTPServer carries the protocol. With a batch_size == 1
@@ -19,7 +21,11 @@ engine a lock serializes model access (the reference's single-threaded
 accept loop, same effective policy); with batch_size > 1 a LaneScheduler
 serves requests CONCURRENTLY over the engine's batch lanes — per-lane
 parked prefill admits new requests while other conversations stream, a
-capability the reference does not have.
+capability the reference does not have. On the lane path, prompt-prefix
+reuse is CROSS-LANE: a PagedKVManager (kv/manager.py) matches every
+admission against a shared radix tree of previously served prefixes and
+adopts the covering pool pages into the lane, so a system prompt fanned
+out over N streams is prefilled and stored once.
 """
 
 from __future__ import annotations
@@ -150,9 +156,11 @@ class _LaneState:
     temperature: float
     top_p: float
     seed: int | None = None  # per-lane sampled-stream reproducibility
-    # conversation bookkeeping for this lane's NaiveCache push on finish
-    delta_messages: list = field(default_factory=list)
-    prompt_end: int = 0
+    # every token FED to the engine so far (prompt + generated, in feed
+    # order). KV rows [0, pos) hold exactly history[:pos]; the final entry
+    # is the pending token whose row is written by the next decode step.
+    # _finish publishes history[:pos] into the shared page pool.
+    history: list = field(default_factory=list)
 
 
 @dataclass
@@ -165,14 +173,16 @@ class _AdmittingLane:
     last fill token lands and the lane flips to a _LaneState."""
 
     job: LaneJob
-    tokens: list[int]  # full delta prompt, pending token included
+    tokens: list[int]  # full conversation prompt, pending token included
     pos0: int
-    cursor: int  # fill tokens already written to the lane's cache
+    cursor: int  # fill tokens already in the lane's cache (adopted rows
+    # count: the chunked prefill starts at the radix-match point)
     prompt_end: int
     max_pos: int
     public_prompt: str
-    delta_messages: list
-    start_pos: int  # reused prefix length (0 = fresh prefill)
+    start_pos: int  # reused (adopted) prefix length, 0 = fresh prefill
+    adopt_pages: list = field(default_factory=list)  # pool pages to copy in
+    adopted: bool = False  # the adopt dispatch ran (it is its own tick)
     n_chunks: int = 0
     prefill_s: float = 0.0  # chunk dispatch time only, decode excluded
 
@@ -198,6 +208,23 @@ def resolve_lane_knobs(
     return int(lane_block_size), int(admission_chunk)
 
 
+def resolve_kv_knobs(
+    kv_page_size: int | None = None, kv_pool_pages: int | None = None
+) -> tuple[int, int]:
+    """Paged-KV knob resolution, same precedence as the lane knobs:
+    explicit (CLI flag) beats env (DLLAMA_KV_PAGE_SIZE /
+    DLLAMA_KV_POOL_PAGES) beats default. page_size 0 = the manager's
+    default (16); page_size < 0 DISABLES the paged pool (the lane path
+    then has no prefix reuse at all — the sharing-off baseline the
+    serving bench compares against). pool_pages 0 = auto-size from the
+    engine (2 * seq_len/page_size + 1)."""
+    if kv_page_size is None:
+        kv_page_size = _env_int("DLLAMA_KV_PAGE_SIZE", 0)
+    if kv_pool_pages is None:
+        kv_pool_pages = _env_int("DLLAMA_KV_POOL_PAGES", 0)
+    return int(kv_page_size), int(kv_pool_pages)
+
+
 class LaneScheduler:
     """Continuous-batching loop over the engine's batch lanes.
 
@@ -209,13 +236,16 @@ class LaneScheduler:
     accept loop (src/dllama-api.cpp:563-574) lacks entirely: N clients
     stream simultaneously at roughly the single-stream decode rate.
 
-    Each lane keeps its own NaiveCache: a continuing conversation is
-    routed back to the (free) lane still holding its KV prefix and only
-    the delta is prefilled — per-lane prompt-prefix reuse under
-    concurrency (the reference's NaiveCache serves its single stream,
-    src/dllama-api.cpp:298-343). The last generated token is carried as
-    a "pending" token and fed at resume, so the resumed context contains
-    exactly the tokens the conversation produced.
+    Prompt-prefix reuse is CROSS-LANE and shared (PR6, replacing the
+    per-lane NaiveCaches): every admission retokenizes the full
+    conversation and matches it against the PagedKVManager's radix tree
+    of previously served token prefixes. Matched pool pages are adopted
+    (device-copied) into the lane and only the unmatched suffix runs
+    through the chunked prefill; on finish, the lane's fed history is
+    published back into the pool, deduplicated against the tree so a
+    prefix N streams share is physically stored once. Any free lane can
+    serve any conversation — affinity routing is gone because the prefix
+    store is no longer trapped in lane-local KV.
     """
 
     def __init__(
@@ -237,12 +267,11 @@ class LaneScheduler:
             else max(self.engine.prefill_buckets)
         )
         self.lanes: list[_LaneState | None] = [None] * self.engine.batch_size
-        self.lane_cache = [NaiveCache() for _ in range(self.engine.batch_size)]
-        # each lane's final generated token (its KV row is unwritten; it
-        # is fed at the cache's recorded end position on resume)
-        self.lane_pending: list[int | None] = [None] * self.engine.batch_size
-        # admission counter per lane: evict the least-recently-used cache
-        # when a fresh conversation needs a lane
+        # shared paged-KV pool + radix prefix tree (None = sharing off)
+        self.kv = state.kv_manager
+        # admission counter per lane: fresh admissions prefer the
+        # least-recently-used free lane (keeps a rough spread for the
+        # flight recorder; no KV state rides on the choice anymore)
         self.lane_used: list[int] = [0] * self.engine.batch_size
         self._admission_count = 0
         # lanes mid-admission (resumable chunked prefill state machine)
@@ -294,29 +323,11 @@ class LaneScheduler:
                 ]
                 while self.pending and free:
                     job = self.pending.pop(0)
-                    # conversation affinity: prefer the free lane whose
-                    # cache already holds this conversation's prefix; for
-                    # fresh conversations prefer an EMPTY lane, then the
-                    # least-recently-used one, so a live conversation's
-                    # reusable cache isn't the first thing evicted
-                    lane = max(
-                        free,
-                        key=lambda i: (
-                            self.lane_cache[i].probe(job.params.messages),
-                            not self.lane_cache[i].items,
-                            -self.lane_used[i],
-                        ),
-                    )
+                    # any lane serves any conversation (the prefix store is
+                    # the shared pool, not lane KV): take the
+                    # least-recently-used free lane
+                    lane = min(free, key=lambda i: self.lane_used[i])
                     free.remove(lane)
-                    if (
-                        self.lane_cache[lane].items
-                        and self.lane_cache[lane].probe(job.params.messages)
-                        == 0
-                    ):
-                        # a fresh conversation takes a lane that still held
-                        # another conversation's reusable prefix
-                        self.state.m_evictions.inc()
-                        self.state.recorder.record("evict", lane=lane)
                     self._admission_count += 1
                     self.lane_used[lane] = self._admission_count
                     admissions.append((lane, job))
@@ -378,8 +389,12 @@ class LaneScheduler:
                                 self.state.m_finished.labels(
                                     reason="error"
                                 ).inc()
-                        self.lane_cache[lane].clear()
-                        self.lane_pending[lane] = None
+                    if self.kv is not None:
+                        # the failed dispatch donated the lane CACHE, not
+                        # the page pool (decode/prefill never donate it):
+                        # stored prefixes stay valid, only the dropped
+                        # lanes' page retains need releasing
+                        self.kv.release_all_lanes()
                     self._set_lane_gauge()
                     with self.cv:
                         self.cv.notify_all()
@@ -390,18 +405,32 @@ class LaneScheduler:
 
     def _begin_admission(self, lane: int, job: LaneJob) -> None:
         """Resolve the prompt and park it as an _AdmittingLane — the front
-        half of the old monolithic _admit, with NO engine work: chunks run
-        one per tick in _admission_tick. Validation failures here precede
-        any engine call, so the lane's cached conversation stays intact
-        and reusable, exactly as before."""
+        half of the old monolithic _admit, with NO engine work: the adopt
+        copy and the prefill chunks run one per tick in _admission_tick.
+        Validation failures here precede any engine call.
+
+        The FULL conversation is retokenized every time and matched
+        against the shared radix tree: a continuing conversation reuses
+        its stored prefix from ANY lane (the template renders
+        prefix-stable transcripts, so turn N's rendering begins with turn
+        N-1's), and so does an unrelated request that shares a system
+        prompt. The match is token-granular; the chunked prefill then
+        covers only positions [start_pos, prompt_end)."""
         state, tok = self.state, self.state.tokenizer
         p = job.params
         try:
-            cache = self.lane_cache[lane]
-            delta_prompt, start_pos = cache.resolve_delta_prompt(p.messages)
+            items = [ChatItem(m.role, m.content) for m in p.messages]
+            prompt = state.template.generate(items, append_generation_prompt=True)
+            tokens = tok.encode(
+                prompt.content, is_start=True, add_special_tokens=True
+            )
+            start_pos, adopt_pages = 0, []
+            if self.kv is not None:
+                start_pos, adopt_pages = self.kv.match(tokens)
             if start_pos > 0:
                 state.m_prefix_hits.inc()
                 state.m_reused_tokens.inc(start_pos)
+                self.kv.note_hit(start_pos)
             else:
                 state.m_prefix_misses.inc()
             qw = job.span.mark_admitted(
@@ -409,28 +438,11 @@ class LaneScheduler:
             )
             state.m_queue_wait.observe(qw)
             state.m_admissions.inc()
-            pending = self.lane_pending[lane] if start_pos > 0 else None
-            if start_pos == 0:
-                self.lane_pending[lane] = None
-            items = [ChatItem(m.role, m.content) for m in delta_prompt]
-            prompt = state.template.generate(items, append_generation_prompt=True)
-            tokens = tok.encode(
-                prompt.content,
-                is_start=start_pos == 0,
-                add_special_tokens=True,
-            )
-            if pending is not None:
-                # feed the conversation's final generated token first (its
-                # KV row was never written — the single-stream path runs a
-                # KV-only decode_step for this, complete() above); it
-                # belongs at the cache's recorded end position, start_pos
-                tokens = [pending] + tokens
-            pos0 = start_pos
             seq_len = self.engine.header.seq_len
-            prompt_end = pos0 + len(tokens) - 1
+            prompt_end = len(tokens) - 1
             if prompt_end >= seq_len:
                 raise ValueError(
-                    f"prompt of {len(tokens)} tokens at pos {pos0} exceeds "
+                    f"prompt of {len(tokens)} tokens exceeds "
                     f"seqLen {seq_len}"
                 )
             max_pos = (
@@ -442,13 +454,13 @@ class LaneScheduler:
             self.admitting[lane] = _AdmittingLane(
                 job=job,
                 tokens=tokens,
-                pos0=pos0,
-                cursor=0,
+                pos0=0,
+                cursor=start_pos,
                 prompt_end=prompt_end,
                 max_pos=max_pos,
                 public_prompt=prompt.public_prompt or "",
-                delta_messages=list(delta_prompt),
                 start_pos=start_pos,
+                adopt_pages=adopt_pages,
             )
         except Exception as e:
             job.events.put(("error", str(e)))
@@ -471,7 +483,15 @@ class LaneScheduler:
             return
         fills = adm.tokens[:-1]
         try:
-            if adm.cursor < len(fills):
+            if adm.adopt_pages and not adm.adopted:
+                # the adopt copy is this lane's first tick action and is
+                # its own tick (one bounded engine dispatch per tick, same
+                # budget discipline as a prefill chunk)
+                t0 = self._clock()
+                self.kv.adopt(lane, adm.adopt_pages)
+                adm.prefill_s += self._clock() - t0
+                adm.adopted = True
+            elif adm.cursor < len(fills):
                 t0 = self._clock()
                 width = self.engine.prefill_lane_chunk(
                     lane,
@@ -488,20 +508,21 @@ class LaneScheduler:
                     pos=adm.pos0 + adm.cursor - width, n_tokens=width,
                     done=adm.cursor >= len(fills),
                 )
-            if adm.cursor >= len(fills):
+            if adm.cursor >= len(fills) and (
+                adm.adopted or not adm.adopt_pages
+            ):
                 self._finish_admission(lane, adm)
         except Exception as e:
-            # a failed chunk releases the lane exactly like the old
-            # monolithic failure path: error the job, and because the
-            # engine was touched, drop this lane's cache + pending token
-            # (the prefill may have partially written it)
+            # a failed adopt/chunk releases the lane exactly like the old
+            # monolithic failure path: error the job and drop any page
+            # retains (the lane's partial KV is overwritten by the next
+            # admission anyway)
             job.events.put(("error", str(e)))
             if job.span.finish("error") is not None:
                 self.state.m_finished.labels(reason="error").inc()
             self.admitting.pop(lane, None)
-            if self.lane_cache[lane].items:
-                self.lane_cache[lane].clear()
-            self.lane_pending[lane] = None
+            if self.kv is not None:
+                self.kv.release_lane(lane)
 
     def _finish_admission(self, lane: int, adm: _AdmittingLane) -> None:
         """Last fill token landed: install the decode-side _LaneState.
@@ -533,8 +554,7 @@ class LaneScheduler:
             temperature=p.temperature,
             top_p=p.top_p,
             seed=p.seed,
-            delta_messages=adm.delta_messages,
-            prompt_end=adm.prompt_end,
+            history=list(adm.tokens),
         )
         del self.admitting[lane]
         self._set_lane_gauge()
@@ -554,11 +574,9 @@ class LaneScheduler:
             if reason == "cancelled":
                 self.state.m_cancellations.inc()
         job.events.put(("done", reason))
-        if adm.cursor > 0:
-            # partially prefilled KV no longer matches a recordable
-            # conversation (same rule as a cancelled decode in _finish)
-            self.lane_cache[lane].clear()
-            self.lane_pending[lane] = None
+        if self.kv is not None:
+            # nothing publishable mid-admission; just drop page retains
+            self.kv.release_lane(lane)
         self.state.recorder.record(
             "finish", lane=lane, reason=reason, pos=adm.pos0 + adm.cursor,
             n_completion=0,
@@ -566,22 +584,16 @@ class LaneScheduler:
 
     def _finish(self, lane: int, reason: str) -> None:
         ls = self.lanes[lane]
-        cache = self.lane_cache[lane]
-        if reason in ("stop", "length") and ls.pos < self.engine.header.seq_len:
-            # record the conversation for prefix reuse: delta messages at
-            # the prompt end, the assistant turn at the current position;
-            # the final token is carried as pending and fed on resume
-            for m in ls.delta_messages:
-                cache.push(NaiveCacheItem(ls.prompt_end, m))
-            cache.push(
-                NaiveCacheItem(ls.pos, ChatMessage("assistant", ls.job.buffer))
-            )
-            self.lane_pending[lane] = ls.token
-        else:
-            # cancelled / errored / out of cache: this lane's KV no longer
-            # matches a recordable conversation
-            cache.clear()
-            self.lane_pending[lane] = None
+        if self.kv is not None:
+            if reason in ("stop", "length"):
+                # publish the fed history's whole pages into the shared
+                # pool BEFORE signalling done, so a client's immediate
+                # follow-up request (any lane) matches this conversation.
+                # Dedup inside publish keeps shared prefixes stored once.
+                self.kv.publish(lane, ls.history[: ls.pos])
+            # cancelled/errored streams publish nothing; either way the
+            # lane's adopted-page retains are released now
+            self.kv.release_lane(lane)
         if ls.job.span.finish(
             reason,
             n_prompt=ls.job.n_prompt_tokens,
@@ -645,6 +657,7 @@ class LaneScheduler:
                 t = row[lane]
                 ls.pos += 1
                 ls.token = t
+                ls.history.append(t)
                 ls.job.n_completion += 1
                 if ls.job.n_completion == 1:
                     ttft = ls.job.span.mark_first_token()
@@ -678,6 +691,8 @@ class ApiState:
         tracer: Tracer | None = None,
         lane_block_size: int = 8,
         admission_chunk: int | None = None,
+        kv_page_size: int = 0,
+        kv_pool_pages: int = 0,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
@@ -738,7 +753,8 @@ class ApiState:
         )
         self.m_prefix_hits = self.obs.counter(
             "dllama_prefix_cache_hits_total",
-            "Admissions that reused a NaiveCache prompt prefix.",
+            "Admissions that reused a stored prompt prefix (radix-tree "
+            "match on the lane path, NaiveCache on the serialized path).",
         )
         self.m_prefix_misses = self.obs.counter(
             "dllama_prefix_cache_misses_total",
@@ -746,12 +762,13 @@ class ApiState:
         )
         self.m_reused_tokens = self.obs.counter(
             "dllama_reused_prefix_tokens_total",
-            "KV positions skipped thanks to NaiveCache prefix reuse.",
+            "KV positions skipped thanks to prompt-prefix reuse.",
         )
         self.m_evictions = self.obs.counter(
             "dllama_cache_evictions_total",
-            "Lane NaiveCaches overwritten by an unrelated conversation "
-            "(LRU lane choice).",
+            "Stored prompt prefixes dropped to make room: radix-tree LRU "
+            "page evictions on the lane path (see also "
+            "dllama_radix_evictions_total).",
         )
         self.m_cancellations = self.obs.counter(
             "dllama_sse_cancellations_total",
@@ -798,13 +815,26 @@ class ApiState:
         # batch_size > 1 engines serve requests CONCURRENTLY over the
         # engine's batch lanes (the reference's accept loop — and the
         # batch_size == 1 path here — serves one request at a time)
+        lanes_on = engine.batch_size > 1 and engine.sp == 1
+        # shared paged-KV pool + radix prefix tree for the lane path
+        # (kv_page_size < 0 = sharing off, the bench baseline)
+        self.kv_manager = None
+        if lanes_on and kv_page_size >= 0:
+            from ..kv.manager import PagedKVManager
+
+            self.kv_manager = PagedKVManager(
+                engine,
+                page_size=kv_page_size,
+                n_pages=kv_pool_pages,
+                evict_counter=self.m_evictions,
+            )
         self.scheduler = (
             LaneScheduler(
                 self,
                 block_size=lane_block_size,
                 admission_chunk=admission_chunk,
             )
-            if engine.batch_size > 1 and engine.sp == 1
+            if lanes_on
             else None
         )
         self.m_lanes_total.set(
@@ -1064,6 +1094,7 @@ _KNOWN_PATHS = frozenset(
         "/v1/debug/recorder",
         "/v1/debug/memory",
         "/v1/debug/compile",
+        "/v1/debug/kv",
         "/metrics",
         "/health",
         "/healthz",
@@ -1174,6 +1205,15 @@ def make_handler(state: ApiState):
                         ),
                     }
                 )
+            elif self.path == "/v1/debug/kv":
+                # paged-KV pool + radix tree accounting (lane path);
+                # {"enabled": false} when sharing is off or single-lane
+                if state.kv_manager is None:
+                    self._json({"enabled": False})
+                else:
+                    payload = state.kv_manager.debug()
+                    payload["enabled"] = True
+                    self._json(payload)
             elif self.path == "/v1/debug/compile":
                 self._json(
                     {
@@ -1374,8 +1414,11 @@ def serve(
     postmortem_dir: str | None = None,
     lane_block_size: int | None = None,
     admission_chunk: int | None = None,
+    kv_page_size: int | None = None,
+    kv_pool_pages: int | None = None,
 ):
     block, chunk = resolve_lane_knobs(lane_block_size, admission_chunk)
+    page_size, pool_pages = resolve_kv_knobs(kv_page_size, kv_pool_pages)
     state = ApiState(
         engine,
         tokenizer,
@@ -1384,6 +1427,8 @@ def serve(
         tracer=Tracer(sink_path=trace_out) if trace_out else None,
         lane_block_size=block,
         admission_chunk=chunk,
+        kv_page_size=page_size,
+        kv_pool_pages=pool_pages,
     )
     if postmortem_dir:
         # a crashed scheduler loop / engine step dumps the event ring here
@@ -1441,6 +1486,8 @@ def main(argv=None) -> None:
                 postmortem_dir=args.postmortem_dir,
                 lane_block_size=args.lane_block_size,
                 admission_chunk=args.admission_chunk,
+                kv_page_size=args.kv_page_size,
+                kv_pool_pages=args.kv_pool_pages,
             )
             server.serve_forever()
             return

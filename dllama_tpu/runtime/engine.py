@@ -380,6 +380,12 @@ class InferenceEngine:
         # concurrent dispatch on the serving thread.
         self._param_specs = jax.tree.map(_sds, self.params)
         self._cache_specs = jax.tree.map(_sds, self.cache)
+        # shared KV page pool (cross-lane prefix sharing): allocated on
+        # demand by init_kv_pool; None means the paged path is off
+        self.kv_pool = None
+        self._kv_page_size = 0
+        self._kv_pool_pages = 0
+        self._kv_pool_specs = None
         self._compiled = {}
         self._base_key = jax.random.PRNGKey(seed)
         self._lane_seed_base = seed
@@ -1025,6 +1031,21 @@ class InferenceEngine:
                     n, w, origin="prefetch"
                 ),
             )
+        if self.kv_pool is not None:
+            # page-copy programs sit on the admission (adopt) and finish
+            # (publish) paths; pre-build every power-of-two bucket up to a
+            # full sequence's page count
+            max_pages = max(1, self.header.seq_len // self._kv_page_size)
+            b = 1
+            while b <= max_pages:
+                for kind in ("adopt", "publish"):
+                    self._prefetch(
+                        ("kv_" + kind, b),
+                        lambda k=kind, n=b: self._kv_copy_fn(
+                            k, n, origin="prefetch"
+                        ),
+                    )
+                b *= 2
 
     def prefill_lane_chunk(
         self,
@@ -1122,6 +1143,263 @@ class InferenceEngine:
                 "step_complete", step="prefill_lane", lane=lane, pos=pos0,
                 n_tokens=p - pos0, ms=round(dt * 1000, 3),
             )
+
+    # -- paged KV pool (cross-lane prefix sharing) ---------------------------
+
+    def _require_kv_pool(self) -> None:
+        if self.kv_pool is None:
+            raise ValueError("KV page pool not initialized (init_kv_pool)")
+
+    def _kv_pool_sharding(self) -> NamedSharding:
+        # mirror the cache's lead (pp stage) and tp (kv-head) axes; the
+        # page axis replaces the dp batch axis and stays replicated —
+        # pages are lane-free, that is the whole point
+        lead = "pp" if self.pp > 1 else None
+        return NamedSharding(self.mesh, P(lead, None, "tp", None, None))
+
+    def _alloc_kv_pool(self):
+        from ..ops.kv_cache import QuantKV
+
+        h = self.header
+        sharding = self._kv_pool_sharding()
+        shape = (
+            h.n_layers, self._kv_pool_pages, h.n_kv_heads,
+            self._kv_page_size, h.head_dim,
+        )
+        if self.kv_dtype == jnp.int8:
+            def leaf():
+                return QuantKV(
+                    jax.device_put(jnp.zeros(shape, jnp.int8), sharding),
+                    jax.device_put(
+                        jnp.ones(shape[:-1] + (1,), jnp.float32), sharding
+                    ),
+                )
+
+            return {"k": leaf(), "v": leaf()}
+        return {
+            k: jax.device_put(jnp.zeros(shape, self.kv_dtype), sharding)
+            for k in ("k", "v")
+        }
+
+    def init_kv_pool(self, page_size: int, n_pages: int = 0) -> int:
+        """Allocate the shared KV page pool: ``[L, n_pages, KH, page_size,
+        hd]`` per k/v leaf (QuantKV pairs under int8 KV), replicated over
+        the page axis and sharded like the cache elsewhere. Page 0 is the
+        scratch page bucketed copy programs pad with. ``n_pages`` <= 0
+        picks a budget of two full-length sequences' worth of pages.
+        Returns the page count actually allocated."""
+        self._require_lanes()
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if page_size > self._lane_pad:
+            # the bucketed copy loop guarantees (start + bucket) * ps never
+            # exceeds seq_len + lane_pad only when one page fits in the
+            # padding (dynamic_slice would clamp silently and misalign)
+            raise ValueError(
+                f"page_size {page_size} exceeds lane padding {self._lane_pad}"
+            )
+        if n_pages <= 0:
+            n_pages = 2 * (self.header.seq_len // page_size) + 1
+        self._kv_page_size = page_size
+        self._kv_pool_pages = n_pages
+        self.kv_pool = self._alloc_kv_pool()
+        self._kv_pool_specs = jax.tree.map(_sds, self.kv_pool)
+        return n_pages
+
+    def reset_kv_pool(self) -> None:
+        """Reallocate the pool buffer (all page contents dropped). The
+        caller owns resetting its host-side page/radix accounting to
+        match."""
+        self._require_kv_pool()
+        self.kv_pool = self._alloc_kv_pool()
+
+    @contextlib.contextmanager
+    def _kv_pool_guard(self):
+        """Crash consistency for the donated pool buffer (the publish
+        program's analogue of _cache_guard): a failed dispatch may leave
+        the pool half-donated, so rebuild it before re-raising. Host-side
+        accounting is the manager's to reset."""
+        try:
+            yield
+        except BaseException as e:
+            self.recorder.record(
+                "error", error=str(e), error_type=type(e).__name__
+            )
+            try:
+                self.kv_pool = self._alloc_kv_pool()
+            except Exception as rebuild_err:  # pragma: no cover
+                raise rebuild_err from e
+            raise
+
+    def _kv_copy_arg_specs(self, bucket: int):
+        return (
+            self._cache_specs,
+            self._kv_pool_specs,
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((bucket,), jnp.int32),
+        )
+
+    def _kv_copy_fn(self, kind: str, bucket: int, origin: str = "dispatch"):
+        """Jitted page-copy program: ``adopt`` gathers ``bucket`` pool
+        pages into a lane's slab rows ``[start*ps, (start+bucket)*ps)``
+        (donates the cache), ``publish`` scatters those slab rows into
+        pool pages (donates the pool). One program per (kind, bucket) —
+        bucketed like prefill so the compile-cache footprint stays
+        O(log max_pages). QuantKV caches work unchanged: jax.tree.map
+        descends into the (values, scales) pair and every op below is
+        shape-generic in the trailing dim."""
+        key = ("kv_" + kind, bucket)
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
+            ev = self._inflight.get(key) if origin == "dispatch" else None
+        if ev is not None:
+            ev.wait()
+            with self._compile_lock:
+                if key in self._compiled:
+                    return self._compiled[key]
+        ps = self._kv_page_size
+
+        if kind == "adopt":
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def fn(cache, pool, lane, start_page, ids):
+                def leaf(c, p):
+                    pages = p[:, ids]  # [L, bucket, KH, ps, last]
+                    l_, _, kh, _, last = pages.shape
+                    rows = pages.transpose(0, 2, 1, 3, 4).reshape(
+                        l_, 1, kh, bucket * ps, last
+                    )
+                    return lax.dynamic_update_slice(
+                        c, rows, (0, lane, 0, start_page * ps, 0)
+                    )
+
+                return jax.tree.map(leaf, cache, pool)
+
+        elif kind == "publish":
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def fn(cache, pool, lane, start_page, ids):
+                def leaf(c, p):
+                    l_, _, kh, _, last = c.shape
+                    rows = lax.dynamic_slice(
+                        c, (0, lane, 0, start_page * ps, 0),
+                        (l_, 1, kh, bucket * ps, last),
+                    )
+                    pages = rows[:, 0].reshape(
+                        l_, kh, bucket, ps, last
+                    ).transpose(0, 2, 1, 3, 4)
+                    return p.at[:, ids].set(pages)
+
+                return jax.tree.map(leaf, cache, pool)
+
+        else:
+            raise ValueError(f"unknown kv copy kind {kind!r}")
+
+        self.recorder.record("compile_start", key=str(key), origin=origin)
+        t0 = time.perf_counter()
+        if self._aot_blocks:
+            fn = fn.lower(*self._kv_copy_arg_specs(bucket)).compile()
+        dt = time.perf_counter() - t0
+        with self._compile_lock:
+            self._compiled[key] = fn
+            self._compile_origin[key] = origin
+            if self._aot_blocks:
+                self._compile_seconds[key] = dt
+        self._m_compiles.labels(origin=origin).inc()
+        self.recorder.record(
+            "compile_end", key=str(key), origin=origin, s=round(dt, 4)
+        )
+        return fn
+
+    def _kv_copy_chunks(self, n: int):
+        """Decompose an n-page copy into decreasing power-of-two buckets.
+        Running largest-first keeps start+bucket <= n at every step, so
+        with page_size <= lane_pad no dynamic_slice can reach past the
+        slab (where it would clamp silently and misalign rows)."""
+        out, start = [], 0
+        while start < n:
+            b = 1
+            while b * 2 <= n - start:
+                b *= 2
+            out.append((start, b))
+            start += b
+        return out
+
+    def kv_adopt(self, lane: int, page_ids: list[int]) -> None:
+        """Copy pool pages into ``lane``'s slab rows ``[0, n*ps)`` — the
+        admission half of prefix sharing: the lane starts its life with
+        the shared prefix's KV already in place and only the unmatched
+        suffix is prefilled. Rows of a partial final page beyond the
+        matched token count hold the donor's stale tail; they are
+        overwritten by suffix prefill before any query position can
+        attend to them (the parked-row garbage argument)."""
+        self._require_kv_pool()
+        if not 0 <= lane < self.batch_size:
+            raise ValueError(f"lane {lane} out of range")
+        n = len(page_ids)
+        if n < 1:
+            raise ValueError("empty page list")
+        if n * self._kv_page_size > self.header.seq_len:
+            raise ValueError(f"{n} pages exceed seqLen {self.header.seq_len}")
+        self.recorder.record(
+            "step_dispatch", step="kv_adopt", lane=lane, n_pages=n
+        )
+        t0 = time.perf_counter()
+        for start, bucket in self._kv_copy_chunks(n):
+            fn = self._kv_copy_fn("adopt", bucket)
+            ids = jnp.asarray(page_ids[start : start + bucket], jnp.int32)
+            with self._cache_guard():
+                self.cache = fn(
+                    self.cache, self.kv_pool,
+                    jnp.int32(lane), jnp.int32(start), ids,
+                )
+        dt = time.perf_counter() - t0
+        self._m_step.labels(kind="kv_adopt").observe(dt)
+        self.recorder.record(
+            "step_complete", step="kv_adopt", lane=lane, n_pages=n,
+            ms=round(dt * 1000, 3),
+        )
+
+    def kv_publish(
+        self, lane: int, page_ids: list[int], start_page: int
+    ) -> None:
+        """Scatter ``lane``'s slab rows ``[start_page*ps, ...)`` into pool
+        pages — the finish half of prefix sharing: a completed stream's
+        full-page KV becomes adoptable by every later admission. The
+        caller dedups against the radix tree first, so only slots the
+        tree does not already hold are written."""
+        self._require_kv_pool()
+        if not 0 <= lane < self.batch_size:
+            raise ValueError(f"lane {lane} out of range")
+        n = len(page_ids)
+        if n < 1:
+            raise ValueError("empty page list")
+        if (start_page + n) * self._kv_page_size > self.header.seq_len:
+            raise ValueError(
+                f"pages [{start_page}, {start_page + n}) exceed "
+                f"seqLen {self.header.seq_len}"
+            )
+        self.recorder.record(
+            "step_dispatch", step="kv_publish", lane=lane, n_pages=n,
+            start_page=start_page,
+        )
+        t0 = time.perf_counter()
+        for off, bucket in self._kv_copy_chunks(n):
+            fn = self._kv_copy_fn("publish", bucket)
+            ids = jnp.asarray(page_ids[off : off + bucket], jnp.int32)
+            with self._kv_pool_guard():
+                self.kv_pool = fn(
+                    self.cache, self.kv_pool,
+                    jnp.int32(lane), jnp.int32(start_page + off), ids,
+                )
+        dt = time.perf_counter() - t0
+        self._m_step.labels(kind="kv_publish").observe(dt)
+        self.recorder.record(
+            "step_complete", step="kv_publish", lane=lane, n_pages=n,
+            ms=round(dt * 1000, 3),
+        )
 
     def _lane_arg_specs(self, n_steps: int):
         """Arg specs for a decode_lanes dispatch (the AOT pre-compile's
