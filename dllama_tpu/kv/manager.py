@@ -4,9 +4,12 @@ Owns the host-side accounting for the engine's device page pool and the
 serving-path policy around it:
 
 - ``match``   — at admission, find the longest stored token prefix of the
-  request's (fully retokenized) conversation and the pages covering it;
-- ``adopt``   — copy those pages into the admitted lane's slab (one
-  bucketed device gather) and retain them for the lane's lifetime;
+  request's (fully retokenized) conversation and the pages covering it,
+  retaining them for the lane on the spot (the scheduler runs the adopt
+  copy a tick later; unpinned pages could be evicted and reallocated to
+  another sequence in that gap);
+- ``adopt``   — copy the matched pages into the admitted lane's slab
+  (one bucketed device gather);
 - ``publish`` — at finish, store the lane's fed tokens' whole pages back
   into the pool, deduplicating against the tree so a prefix two streams
   share is physically stored ONCE (the second publisher allocates pages
@@ -102,35 +105,43 @@ class PagedKVManager:
         self.g_shared.set(st.shared)
 
     # -- admission ---------------------------------------------------------
-    def match(self, tokens: list[int]) -> tuple[int, list[int]]:
+    def match(self, lane: int, tokens: list[int]) -> tuple[int, list[int]]:
         """Longest reusable stored prefix of ``tokens``: returns
         ``(n_reused_tokens, pages)``. Reuse is capped one short of the
         prompt (the engine must be fed at least one token) and to the
         rows the collected pages actually cover; a partial final page is
         fine (its stale tail rows are overwritten by suffix prefill
-        before any query can attend to them)."""
+        before any query can attend to them).
+
+        The returned pages are retained for ``lane`` HERE, inside the
+        lock: the scheduler runs the adopt copy one tick later, and
+        another lane's publish->evict in that window could otherwise
+        free and reallocate refcount-1 pages, silently handing the new
+        lane a different sequence's KV. Every admission-failure path
+        already funnels through :meth:`release_lane`, which drops the
+        retain whether or not the adopt copy ever ran."""
         ps = self.page_size
         with self.lock:
-            mr = self.tree.match(tokens)
-            m = min(mr.n_tokens, len(mr.pages) * ps, len(tokens) - 1)
-            if m <= 0:
-                return 0, []
-            n_pages = -(-m // ps)  # ceil
-            return m, mr.pages[:n_pages]
-
-    def adopt(self, lane: int, pages: list[int]) -> None:
-        """Device-copy ``pages`` into ``lane``'s slab and retain them for
-        the lane's lifetime (retained pages cannot be evicted out from
-        under a live stream)."""
-        self.engine.kv_adopt(lane, pages)
-        with self.lock:
-            self.pool.retain(pages)
             # a lane admitted twice without release would leak a retain
             stale = self._lane_pages.pop(lane, None)
             if stale:
                 self.pool.release(stale)
+            mr = self.tree.match(tokens)
+            m = min(mr.n_tokens, len(mr.pages) * ps, len(tokens) - 1)
+            if m <= 0:
+                self._update_gauges_locked()
+                return 0, []
+            n_pages = -(-m // ps)  # ceil
+            pages = mr.pages[:n_pages]
+            self.pool.retain(pages)
             self._lane_pages[lane] = list(pages)
             self._update_gauges_locked()
+            return m, pages
+
+    def adopt(self, lane: int, pages: list[int]) -> None:
+        """Device-copy ``pages`` (already retained by :meth:`match`)
+        into ``lane``'s slab."""
+        self.engine.kv_adopt(lane, pages)
 
     def release_lane(self, lane: int) -> None:
         with self.lock:
@@ -158,31 +169,43 @@ class PagedKVManager:
             n_new = n_full - k_shared
             if n_new == 0:
                 return 0
-            short = n_new - self.pool.free_pages
-            if short > 0:
-                freed = self.tree.evict(short, self.pool)
-                self.c_evictions.inc(freed)
-                if self._evict_counter is not None:
-                    self._evict_counter.inc(freed)
-                if freed:
-                    self.recorder.record("kv_evict", n_pages=freed, lane=lane)
-            if n_new > self.pool.free_pages:
-                # pool is full of retained/live pages: skip publishing
-                # rather than stall (the stream already served; only future
-                # reuse is lost)
-                self.recorder.record(
-                    "kv_publish_skipped", lane=lane, want=n_new,
-                    free=self.pool.free_pages,
+            # Pin the matched prefix across the eviction: under pool
+            # pressure the matched leaf itself can be the refcount-1 LRU
+            # victim, which would leave ``mr``/``k_shared`` pointing at
+            # freed (possibly reallocated) pages and the insert below
+            # rebuilding a token path with no pages behind its lower
+            # slots. Pinned pages are refcount >= 2 and unevictable.
+            self.pool.retain(mr.pages)
+            try:
+                short = n_new - self.pool.free_pages
+                if short > 0:
+                    freed = self.tree.evict(short, self.pool)
+                    self.c_evictions.inc(freed)
+                    if self._evict_counter is not None:
+                        self._evict_counter.inc(freed)
+                    if freed:
+                        self.recorder.record(
+                            "kv_evict", n_pages=freed, lane=lane
+                        )
+                if n_new > self.pool.free_pages:
+                    # pool is full of retained/live pages: skip publishing
+                    # rather than stall (the stream already served; only
+                    # future reuse is lost)
+                    self.recorder.record(
+                        "kv_publish_skipped", lane=lane, want=n_new,
+                        free=self.pool.free_pages,
+                    )
+                    return 0
+                diverged_mid_page = (
+                    mr.n_tokens > k_shared * ps and len(mr.pages) > k_shared
                 )
-                return 0
-            diverged_mid_page = (
-                mr.n_tokens > k_shared * ps and len(mr.pages) > k_shared
-            )
-            if diverged_mid_page:
-                pages = [self.pool.fork(mr.pages[k_shared])]
-                pages += self.pool.alloc(n_new - 1)
-            else:
-                pages = self.pool.alloc(n_new)
+                if diverged_mid_page:
+                    pages = [self.pool.fork(mr.pages[k_shared])]
+                    pages += self.pool.alloc(n_new - 1)
+                else:
+                    pages = self.pool.alloc(n_new)
+            finally:
+                self.pool.release(mr.pages)
         try:
             self.engine.kv_publish(lane, pages, start_page=k_shared)
         except BaseException:
@@ -193,7 +216,17 @@ class PagedKVManager:
             self.reset(reset_device=False)
             return 0
         with self.lock:
-            self.tree.insert(full, pages, first_slot=k_shared)
+            try:
+                self.tree.insert(full, pages, first_slot=k_shared)
+            except Exception:
+                # insert validates that dedup'd slots still exist on the
+                # stored path; a rejection means the accounting raced —
+                # drop the new pages and skip the store instead of
+                # crashing the scheduler (only future reuse is lost)
+                logger.exception("kv radix insert rejected; publish dropped")
+                self.pool.release(pages)
+                self._update_gauges_locked()
+                return 0
             self._update_gauges_locked()
         return n_new
 

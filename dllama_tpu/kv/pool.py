@@ -77,7 +77,9 @@ class PagePool:
 
     def fork(self, src: int) -> int:
         """COW-fork accounting: allocate a private copy slot for ``src``."""
-        if src not in self._refs and src != SCRATCH_PAGE:
+        if src == SCRATCH_PAGE:
+            raise KeyError(f"fork of reserved scratch page {src}")
+        if src not in self._refs:
             raise KeyError(f"fork of unallocated page {src}")
         page = self.alloc(1)[0]
         self._cow_forks += 1

@@ -117,6 +117,39 @@ class RadixTree:
                 f"pages for slots [{first_slot}, {first_slot + len(new_pages)}) "
                 f"do not reach sequence end (slot {n_full})"
             )
+        if first_slot > 0:
+            # Slots the caller dedup'd away must actually be stored: an
+            # insert whose lower slots are missing (e.g. the matched leaf
+            # was evicted after the caller's match) would otherwise build
+            # a token path whose early positions have NO pages behind
+            # them, and later matches would hand out the suffix pages as
+            # if they covered slot 0. Validate BEFORE any mutation.
+            need = first_slot * ps
+            covered: set = set()
+            node, pos = self.root, 0
+            while pos < need:
+                child = node.children.get(tokens[pos])
+                if child is None:
+                    break
+                edge = child.tokens
+                j = 0
+                limit = min(len(edge), len(tokens) - pos)
+                while j < limit and edge[j] == tokens[pos + j]:
+                    j += 1
+                if j > 0:
+                    covered.update(s for s, _ in child.pages)
+                pos += j
+                if j < len(edge):
+                    break
+                node = child
+            missing = set(range(first_slot)) - covered
+            if pos < need or missing:
+                raise ValueError(
+                    f"insert(first_slot={first_slot}) on a path storing "
+                    f"only {pos} matching tokens, missing page slots "
+                    f"{sorted(missing)} — dedup'd slots must already "
+                    "exist on the matched path"
+                )
         now = self._tick()
         node = self.root
         pos = 0
@@ -211,8 +244,14 @@ class RadixTree:
             self._n_pages -= len(victim.pages)
             parent = victim.parent
             del parent.children[victim.tokens[0]]
-            # A now-childless, pageless parent is dead weight; the next sweep
-            # sees it as a zero-page leaf and removes it for free.
+            # Collapse now-childless, pageless ancestors immediately:
+            # left in place they are match()-able token spans with no
+            # pages behind them, inflating node/token counts forever if
+            # pressure never recurs.
+            node = parent
+            while node is not self.root and not node.children and not node.pages:
+                del node.parent.children[node.tokens[0]]
+                node = node.parent
         return freed
 
     # -- introspection -----------------------------------------------------

@@ -426,7 +426,10 @@ class LaneScheduler:
             )
             start_pos, adopt_pages = 0, []
             if self.kv is not None:
-                start_pos, adopt_pages = self.kv.match(tokens)
+                # match retains the pages for this lane immediately —
+                # the adopt copy runs a tick later and unpinned pages
+                # could be evicted/reallocated in between
+                start_pos, adopt_pages = self.kv.match(lane, tokens)
             if start_pos > 0:
                 state.m_prefix_hits.inc()
                 state.m_reused_tokens.inc(start_pos)
@@ -466,6 +469,10 @@ class LaneScheduler:
             job.events.put(("error", str(e)))
             if job.span.finish("error") is not None:
                 state.m_finished.labels(reason="error").inc()
+            if self.kv is not None:
+                # a validation failure after the match (e.g. prompt too
+                # long) must drop the pages match() just retained
+                self.kv.release_lane(lane)
 
     def _admission_tick(self) -> None:
         """Run at most ONE bounded prefill chunk for ONE admitting lane

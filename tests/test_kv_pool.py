@@ -77,6 +77,8 @@ def test_page_pool_invariants():
         pool.release([SCRATCH_PAGE])
     with pytest.raises(KeyError):
         pool.retain([999])
+    with pytest.raises(KeyError):
+        pool.fork(SCRATCH_PAGE)  # padded page-id vectors must not leak in
 
     pool.reset()
     assert pool.free_pages == 7 and pool.stats().used == 0
@@ -163,6 +165,134 @@ def test_radix_lru_eviction_respects_refcounts():
     tree.clear(pool)
     assert pool.free_pages == 15
     pool.check()
+
+
+@pytest.mark.fast
+def test_radix_insert_rejects_gapped_path():
+    """insert(first_slot=k) whose dedup'd lower slots are NOT stored
+    (e.g. the matched leaf was evicted after the caller's match) must
+    raise before mutating anything, never build a token path with no
+    pages behind its early positions."""
+    pool = PagePool(8, PS)
+    tree = RadixTree(PS)
+    A = [1, 2, 3, 4, 5, 6, 7, 8]
+    pa = pool.alloc(1)
+    with pytest.raises(ValueError):
+        tree.insert(A, pa, first_slot=1)  # slot 0 was never stored
+    assert tree.node_count() == 0 and tree.n_pages == 0
+
+    # ...and with a stored-but-too-short prefix it still refuses
+    tree.insert(A[:4], pa, first_slot=0)
+    pb = pool.alloc(1)
+    B = A + [9, 10, 11, 12]
+    with pytest.raises(ValueError):
+        tree.insert(B, pb, first_slot=2)  # slot 1 missing from the path
+    assert tree.n_pages == 1
+    pool.check()
+
+
+@pytest.mark.fast
+def test_radix_evict_collapses_dead_ancestors():
+    """Evicting a leaf must also remove now-childless, pageless
+    ancestors: left behind they are match()-able token spans with no
+    pages, inflating node/token counts until the next pressure event."""
+    pool = PagePool(16, PS)
+    tree = RadixTree(PS)
+    A = [1, 2, 3, 4, 5, 6, 7, 8]
+    B = [1, 2, 30, 40, 50, 60, 70, 80]  # splits A's first edge at offset 2
+    tree.insert(A, pool.alloc(2), first_slot=0)
+    tree.insert(B, pool.alloc(2), first_slot=0)
+    # the split head [1, 2] holds no pages (no slot ends inside it)
+    assert tree.evict(4, pool) == 4
+    assert tree.node_count() == 0 and tree.token_count() == 0
+    assert tree.n_pages == 0
+    assert tree.match(A) == MatchResult(0, [])
+    pool.check()
+
+
+# -- PagedKVManager host accounting (no device) -------------------------------
+
+
+class _StubEngine:
+    """Host-accounting-only stand-in: the manager's match/publish
+    bookkeeping races need no device to reproduce."""
+
+    def init_kv_pool(self, page_size, n_pages):
+        return n_pages
+
+    def kv_adopt(self, lane, pages):
+        pass
+
+    def kv_publish(self, lane, pages, start_page):
+        pass
+
+    def reset_kv_pool(self):
+        pass
+
+
+@pytest.mark.fast
+def test_publish_pressure_pins_matched_prefix():
+    """Regression: a publish extending a stored prefix under pool
+    pressure must not LRU-evict that prefix's own refcount-1 leaf out
+    from under its MatchResult — previously the stale ``mr`` made
+    insert rebuild a gapped token path and later matches returned
+    suffix pages as if they covered slot 0 (cross-request KV
+    corruption)."""
+    from dllama_tpu.kv.manager import PagedKVManager
+
+    kv = PagedKVManager(_StubEngine(), page_size=PS, n_pages=6)  # 5 usable
+    A = [10 + i for i in range(8)]  # 2 pages, tree-only (refcount 1)
+    assert kv.publish(0, A) == 2
+    pa = kv.tree.match(A).pages
+
+    # B extends A by 4 pages: 3 free, 1 short — and the ONLY refcount-1
+    # leaf is A's own, which this publish just matched. It must be
+    # pinned: eviction frees nothing and the publish is skipped whole.
+    B = A + [60 + i for i in range(16)]
+    assert kv.publish(1, B) == 0
+    m = kv.tree.match(A)
+    assert m.n_tokens == 8 and m.pages == pa  # prefix intact, same pages
+    assert kv.tree.match(B).n_tokens == 8  # only the old prefix stored
+    kv.check()
+
+    # the pin was transient: a fitting publish still works afterwards
+    C = [200 + i for i in range(8)]
+    assert kv.publish(0, C) == 2
+    kv.check()
+
+
+@pytest.mark.fast
+def test_match_retains_pages_until_release():
+    """Regression: match() must pin the returned pages immediately —
+    the scheduler runs the adopt copy a full tick after the match, and
+    another lane's publish->evict in that gap previously freed and
+    reallocated the refcount-1 pages, copying an unrelated sequence's
+    KV into the new lane's prefix rows."""
+    from dllama_tpu.kv.manager import PagedKVManager
+
+    kv = PagedKVManager(_StubEngine(), page_size=PS, n_pages=6)  # 5 usable
+    A = [10 + i for i in range(8)]  # 2 pages, tree-only
+    assert kv.publish(0, A) == 2
+    m, pages = kv.match(1, A + [9])
+    assert m == 8 and pages == kv.tree.match(A).pages
+    assert all(kv.pool.refcount(p) == 2 for p in pages)  # pinned NOW
+
+    # another lane publishes in the match->adopt gap, filling the pool
+    # and then forcing an eviction: the pinned pages are untouchable,
+    # the pressure lands on the other leaf instead
+    D = [90 + i for i in range(12)]
+    assert kv.publish(0, D) == 3  # pool now full
+    E = [300 + i for i in range(4)]
+    assert kv.publish(0, E) == 1  # evicts D's leaf, never A's
+    assert kv.tree.match(D).n_tokens == 0
+    assert kv.tree.match(A).pages == pages
+    assert all(kv.pool.refcount(p) == 2 for p in pages)
+
+    kv.adopt(1, pages)  # device copy only: no double retain
+    assert all(kv.pool.refcount(p) == 2 for p in pages)
+    kv.release_lane(1)  # the single release path drops the match pin
+    assert all(kv.pool.refcount(p) == 1 for p in pages)
+    kv.check()
 
 
 # -- paged gather/scatter/view helpers ---------------------------------------
@@ -373,9 +503,11 @@ def test_manager_dedup_cow_and_eviction(tiny_model):
     assert kv.publish(1, A) == 0
     assert kv.pool.stats().used == used
 
-    # match + adopt: retains shared pages; gauges see refcount >= 2
-    m, pages = kv.match(A + [9])
+    # match pins shared pages for the lane on the spot; adopt is only
+    # the device copy; gauges see refcount >= 2
+    m, pages = kv.match(0, A + [9])
     assert m == 16 and pages == kv.tree.match(A).pages
+    assert kv.pool.stats().shared == 4
     kv.adopt(0, pages)
     assert kv.pool.stats().shared == 4
 
