@@ -275,9 +275,12 @@ def _serving_smoke(n_clients: int) -> dict:
     # a small explicit admission chunk so the churn scenario below pays
     # several chunks per long-prompt admission (the default — the largest
     # prefill bucket, 128 here — would swallow the whole prompt in one)
+    # generous SLO targets (the CI host is slow and shared): the point is
+    # that the attainment/goodput pipeline produces finite numbers, not
+    # that the tiny model meets production latency
     srv = serve(
         engine, tok, host="127.0.0.1", port=0, trace_out=trace_path,
-        admission_chunk=32,
+        admission_chunk=32, slo_ttft_ms=60000.0, slo_tpot_ms=5000.0,
     )
     port = srv.server_address[1]
     threading.Thread(target=srv.serve_forever, daemon=True).start()
@@ -436,6 +439,51 @@ def _serving_smoke(n_clients: int) -> dict:
     c.close()
 
     metrics_text = scrape_metrics()
+
+    # windowed SLO attainment/goodput over the load just served (ISSUE 7)
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    c.request("GET", "/v1/debug/slo")
+    slo_snap = json.loads(c.getresponse().read().decode("utf-8"))
+    c.close()
+    slo_5m = slo_snap["windows"]["5m"]
+    slo = {
+        "targets": slo_snap["targets"],
+        "n_requests_5m": slo_5m["n_requests"],
+        "attainment_5m": slo_5m["attainment"],
+        "ttft_attainment_5m": slo_5m["ttft_attainment"],
+        "goodput_tokens_per_s_5m": slo_5m["goodput_tokens_per_s"],
+        "throughput_tokens_per_s_5m": slo_5m["throughput_tokens_per_s"],
+    }
+
+    # span-timeline export: the Perfetto file must be valid JSON with
+    # spans from every serving component (ISSUE 7 acceptance)
+    timeline_path = os.path.join(d, "timeline.json")
+    srv.state.spans.export_file(timeline_path)
+    with open(timeline_path) as f:
+        tl = json.load(f)
+    pid_names = {
+        ev["pid"]: ev["args"]["name"]
+        for ev in tl["traceEvents"]
+        if ev.get("ph") == "M" and ev.get("name") == "process_name"
+    }
+    tl_counts: dict = {}
+    for ev in tl["traceEvents"]:
+        if ev.get("ph") == "X":
+            comp = pid_names.get(ev["pid"], "?")
+            tl_counts[comp] = tl_counts.get(comp, 0) + 1
+    # per-request millisecond accounting for one traced request: the
+    # coverage fraction is the ">=95% of wall time is spanned" bar
+    tl_reqs = [r for r in read_jsonl(trace_path) if r.get("request_id")]
+    summary = (
+        srv.state.spans.request_summary(tl_reqs[-1]["request_id"])
+        if tl_reqs else {}
+    )
+    timeline = {
+        "n_spans": tl["dllama"]["n_spans"],
+        "dropped": tl["dllama"]["dropped"],
+        "spans_by_component": dict(sorted(tl_counts.items())),
+        "request_coverage": summary.get("coverage"),
+    }
     srv.shutdown()
 
     # sharing-off baseline: fresh engine + server with the pool disabled
@@ -508,13 +556,15 @@ def _serving_smoke(n_clients: int) -> dict:
     waits = sorted(r["queue_wait_s"] * 1000 for r in recs)
 
     # instrumentation overhead: median decode-block wall time with the
-    # registry + flight recorder enabled vs BOTH disabled (same compiled
-    # program, same lanes) — the <2% acceptance bar covers the whole
-    # per-dispatch hook cost, not just the histogram observe
+    # registry + flight recorder + span tracker enabled vs ALL disabled
+    # (same compiled program, same lanes) — the acceptance bar covers the
+    # whole per-dispatch hook cost, not just the histogram observe
     from dllama_tpu.obs.recorder import get_recorder
+    from dllama_tpu.obs.spans import get_span_tracker
 
     reg = get_registry()
     rec = get_recorder()
+    spans_t = get_span_tracker()
 
     def median_block_s(k: int = 9) -> float:
         times = []
@@ -533,7 +583,9 @@ def _serving_smoke(n_clients: int) -> dict:
     on_s = median_block_s()
     reg.disable()
     rec_was_enabled, rec.enabled = rec.enabled, False
+    spans_were_enabled, spans_t.enabled = spans_t.enabled, False
     off_s = median_block_s()
+    spans_t.enabled = spans_were_enabled
     rec.enabled = rec_was_enabled
     reg.enable()
     overhead_pct = (on_s - off_s) / off_s * 100.0 if off_s > 0 else 0.0
@@ -556,6 +608,8 @@ def _serving_smoke(n_clients: int) -> dict:
             metric_value(metrics_text, "dllama_decode_stall_seconds_sum"), 4
         ),
         "prefix_fanout": prefix_fanout,
+        "slo": slo,
+        "timeline": timeline,
         "obs_overhead_pct": round(overhead_pct, 2),
     }
 

@@ -116,6 +116,19 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                    help="write the engine flight recorder's event ring "
                         "as a JSON postmortem into DIR when a step or "
                         "the lane-scheduler loop raises (obs/recorder.py)")
+    p.add_argument("--timeline-out", default=None, metavar="PATH",
+                   help="write the span timeline as Chrome-trace/Perfetto "
+                        "JSON to PATH (obs/spans.py; the API server "
+                        "rewrites it throttled per finished request, the "
+                        "CLI writes it once at exit)")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="TTFT SLO target in ms for the windowed "
+                        "attainment/goodput gauges (obs/slo.py; env "
+                        "DLLAMA_SLO_TTFT_MS; unset = no target)")
+    p.add_argument("--slo-tpot-ms", type=float, default=None,
+                   help="mean-TPOT SLO target in ms for the windowed "
+                        "attainment/goodput gauges (obs/slo.py; env "
+                        "DLLAMA_SLO_TPOT_MS; unset = no target)")
     p.add_argument("--moe-decode-dedup", default="auto", nargs="?",
                    const="on",  # bare flag keeps its r4 meaning (force on)
                    choices=["auto", "on", "off"],
@@ -331,6 +344,17 @@ def run_inference(args) -> None:
     span = tracer.span(path="cli") if tracer is not None else NULL_SPAN
     span.mark_admitted()
 
+    # span timeline of the run (--timeline-out; obs/spans.py): the engine
+    # records prefill/decode_step spans itself, this one is the request-
+    # attributed envelope the per-request summary hangs off
+    from .obs.spans import get_span_tracker
+
+    spans = get_span_tracker()
+    gen_span = spans.begin(
+        "generate", component="cli", request_id=span.request_id,
+        n_prompt=len(tokens), steps=args.steps,
+    )
+
     print(args.prompt)
     with profile(args.profile):
         if measure:
@@ -381,9 +405,13 @@ def run_inference(args) -> None:
             )
             sys.stdout.flush()
 
+    spans.end(gen_span, n_completion=n_pred)
     span.finish("length", n_prompt=len(tokens), n_completion=n_pred)
     if tracer is not None:
         tracer.close()
+    if getattr(args, "timeline_out", None):
+        n_spans = spans.export_file(args.timeline_out)
+        print(f"🧭 timeline: {n_spans} spans -> {args.timeline_out}")
 
     n_eval = max(len(tokens) - 1, 1)
     print()

@@ -29,6 +29,7 @@ import threading
 
 from ..obs.metrics import get_registry
 from ..obs.recorder import get_recorder
+from ..obs.spans import get_span_tracker
 from .pool import PagePool
 from .radix import RadixTree
 
@@ -49,6 +50,9 @@ class PagedKVManager:
         self.page_size = page_size or DEFAULT_PAGE_SIZE
         n = engine.init_kv_pool(self.page_size, n_pages)
         self.recorder = get_recorder()
+        # component="kv" spans over the host-side accounting (the engine's
+        # device copies inside adopt/publish record their own spans)
+        self.spans = get_span_tracker()
         self.pool = PagePool(n, self.page_size, on_event=self._pool_event)
         self.tree = RadixTree(self.page_size)
         self.lock = threading.Lock()
@@ -121,7 +125,9 @@ class PagedKVManager:
         already funnels through :meth:`release_lane`, which drops the
         retain whether or not the adopt copy ever ran."""
         ps = self.page_size
-        with self.lock:
+        with self.spans.span(
+            "kv_match", component="kv", lane=lane, n_tokens=len(tokens)
+        ), self.lock:
             # a lane admitted twice without release would leak a retain
             stale = self._lane_pages.pop(lane, None)
             if stale:
@@ -158,6 +164,13 @@ class PagedKVManager:
         makes a fanned-out system prompt physically one set of pages.
         Returns the number of pages newly stored (0 = full dedup or no
         whole page to store)."""
+        with self.spans.span(
+            "kv_publish_host", component="kv", lane=lane,
+            n_tokens=len(tokens),
+        ):
+            return self._publish(lane, tokens)
+
+    def _publish(self, lane: int, tokens: list[int]) -> int:
         ps = self.page_size
         n_full = len(tokens) // ps
         if n_full == 0:

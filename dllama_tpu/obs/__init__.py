@@ -17,6 +17,13 @@ events with postmortem dumps), device memory telemetry (``device.py``,
 ``device.memory_stats()`` vs the analytic ``memory_report``), and
 compiled-step cost analysis (``cost.py``, XLA flops/bytes vs the HBM
 roofline) — all surfaced by the API server's ``/v1/debug/*`` endpoints.
+
+PR 7 adds the third rung: span timeline tracing (``spans.py``, Chrome-
+trace exports + per-request millisecond accounting behind
+``/v1/debug/timeline`` and ``--timeline-out``), sliding-window SLO
+attainment / goodput (``slo.py``, ``dllama_slo_*`` gauges +
+``/v1/debug/slo``), and the engine watchdog (``watchdog.py``, stall
+detection with auto-postmortem and a degraded ``/v1/health``).
 """
 
 from .cost import (
@@ -39,7 +46,10 @@ from .metrics import (
     get_registry,
 )
 from .recorder import FlightRecorder, get_recorder
+from .slo import SloTracker, resolve_slo_knobs
+from .spans import SpanTracker, get_span_tracker
 from .trace import NULL_SPAN, RequestSpan, Tracer
+from .watchdog import EngineWatchdog, resolve_watchdog_knobs
 
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS_S",
@@ -60,4 +70,10 @@ __all__ = [
     "NULL_SPAN",
     "RequestSpan",
     "Tracer",
+    "SpanTracker",
+    "get_span_tracker",
+    "SloTracker",
+    "resolve_slo_knobs",
+    "EngineWatchdog",
+    "resolve_watchdog_knobs",
 ]

@@ -126,6 +126,35 @@ class _Histogram:
     def sum(self) -> float:
         return self._sum
 
+    def percentile(self, q: float) -> float | None:
+        """Estimate the ``q``-quantile (q in [0, 1]) by linear
+        interpolation inside the bucket holding the target rank — the
+        single shared implementation the watchdog and the SLO windows
+        read p99 block time from. Returns None with no observations.
+        Samples beyond the last finite bucket clamp to its edge (the
+        +Inf bucket has no upper edge to interpolate toward)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        reg = self._family.registry
+        with reg._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return None
+        buckets = self._family.buckets
+        target = q * total
+        cum = 0.0
+        for i, n in enumerate(counts[:-1]):
+            prev = cum
+            cum += n
+            if cum >= target:
+                lo = buckets[i - 1] if i > 0 else 0.0
+                hi = buckets[i]
+                if n == 0:
+                    return hi
+                return lo + (hi - lo) * (target - prev) / n
+        return buckets[-1] if buckets else None
+
 
 _CHILD_TYPES = {"counter": _Counter, "gauge": _Gauge, "histogram": _Histogram}
 
@@ -188,6 +217,9 @@ class _Family:
     @property
     def count(self):
         return self._default().count
+
+    def percentile(self, q: float):
+        return self._default().percentile(q)
 
     def child_values(self) -> dict[tuple[str, ...], float]:
         return {k: c.value for k, c in sorted(self._children.items())

@@ -20,7 +20,12 @@ Event kinds recorded today (see runtime/engine.py + runtime/api_server.py):
   * ``cache_epoch`` — KV-cache rebuilds (init / reset / crash recovery);
   * ``admit`` / ``evict`` / ``finish`` — lane-scheduler decisions;
   * ``error`` / ``scheduler_error`` — failed dispatches and scheduler-
-    loop exceptions.
+    loop exceptions;
+  * ``watchdog_stall`` / ``watchdog_recovered`` — stall episodes the
+    engine watchdog (obs/watchdog.py) detected and cleared;
+  * ``obs_overflow`` / ``obs_sink_error`` — observability failing at its
+    own job: the span ring dropping completed spans, or a trace/timeline
+    sink write failing (the layer degrades, and says so here).
 
 **Postmortem dump**: when a ``postmortem_dir`` is configured
 (``--postmortem-dir`` or ``DLLAMA_POSTMORTEM_DIR``), a crashed step or

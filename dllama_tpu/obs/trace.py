@@ -22,6 +22,18 @@ import uuid
 from collections import deque
 
 
+def _dumps_safe(rec: dict) -> str:
+    """Serialize one trace record, tolerating non-JSON attr values by
+    falling back to ``repr`` — a caller attaching an exception object or
+    a numpy scalar to a span must degrade the trace line, never raise
+    mid-request on the serving thread."""
+    try:
+        return json.dumps(rec, default=repr)
+    except (TypeError, ValueError):
+        # non-string keys or self-referencing structures: keep the line
+        return json.dumps({"_unserializable": repr(rec)})
+
+
 class RequestSpan:
     """One request's lifecycle; see module docstring. All ``*_s`` fields
     are seconds on the monotonic clock, ``submitted_unix`` is wall time."""
@@ -156,25 +168,38 @@ class Tracer:
         return RequestSpan(self, request_id, path)
 
     def record(self, rec: dict) -> None:
-        line = json.dumps(rec)
+        line = _dumps_safe(rec)
+        sink_error = None
         with self._lock:
             self._ring.append(rec)
             if self._sink is not None:
                 try:
                     self._sink.write(line + "\n")
-                except ValueError:  # closed sink: keep the ring alive
+                except (ValueError, OSError) as e:
+                    # closed/broken sink: keep the ring alive, but make
+                    # the observability failure itself observable
                     self._sink = None
+                    sink_error = e
+        if sink_error is not None:
+            from .recorder import get_recorder
+
+            get_recorder().record(
+                "obs_sink_error", what="trace_jsonl",
+                path=self.sink_path, error=str(sink_error),
+                error_type=type(sink_error).__name__,
+            )
 
     def records(self) -> list[dict]:
         with self._lock:
             return list(self._ring)
 
     def export(self, path: str) -> int:
-        """Dump the current ring as JSONL; returns the record count."""
+        """Dump the current ring as JSONL; returns the record count.
+        Non-serializable attr values degrade to ``repr`` per record."""
         recs = self.records()
         with open(path, "w") as f:
             for rec in recs:
-                f.write(json.dumps(rec) + "\n")
+                f.write(_dumps_safe(rec) + "\n")
         return len(recs)
 
     def close(self) -> None:

@@ -13,7 +13,13 @@ Capability port of the reference's `dllama-api` (src/dllama-api.cpp):
   are reused when a new request's messages are a strict superset of the
   previous conversation (src/dllama-api.cpp:298-343);
 * ``GET /v1/debug/kv`` — paged-KV pool / radix-tree introspection
-  (lane-scheduler path).
+  (lane-scheduler path);
+* ``GET /v1/debug/timeline`` — Chrome-trace/Perfetto span timeline
+  (``?request_id=`` narrows to one request and adds its millisecond
+  accounting; obs/spans.py);
+* ``GET /v1/debug/slo`` — windowed SLO attainment / goodput snapshot
+  (obs/slo.py). ``/v1/health`` reports ``status: degraded`` while the
+  engine watchdog (obs/watchdog.py) detects a stall.
 
 The reference hand-rolls an HTTP/1.1 server over raw sockets; here Python's
 stdlib ThreadingHTTPServer carries the protocol. With a batch_size == 1
@@ -37,11 +43,15 @@ import time
 import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 from ..obs.device import compare_with_analytic, sample_device_memory
 from ..obs.metrics import DEFAULT_TOKEN_BUCKETS_S, get_registry
 from ..obs.recorder import get_recorder
+from ..obs.slo import SloTracker, resolve_slo_knobs
+from ..obs.spans import get_span_tracker
 from ..obs.trace import NULL_SPAN, Tracer
+from ..obs.watchdog import EngineWatchdog, resolve_watchdog_knobs
 from ..tokenizer import (
     CHAT_TEMPLATE_NAMES,
     ChatItem,
@@ -143,6 +153,9 @@ class LaneJob:
         # scheduler marks admit/first-token/finish, the handler reads the
         # derived metadata for the response
         self.span = NULL_SPAN
+        # timeline queue span (obs/spans.py): begun at submit on the
+        # handler thread, ended by the scheduler when admission starts
+        self.queue_span = None
 
 
 @dataclass
@@ -161,6 +174,9 @@ class _LaneState:
     # is the pending token whose row is written by the next decode step.
     # _finish publishes history[:pos] into the shared page pool.
     history: list = field(default_factory=list)
+    # timeline span covering the lane's whole decode stretch (admission
+    # done -> finish); the request-attributed backbone of the timeline
+    decode_span: object = None
 
 
 @dataclass
@@ -293,6 +309,12 @@ class LaneScheduler:
     def submit(self, params: InferenceParams) -> LaneJob:
         job = LaneJob(params)
         job.span = self.state.tracer.span(path="lanes")
+        # queue span: begins here on the handler thread, ends on the
+        # scheduler thread once admission work (tokenize + radix match)
+        # is done — so timeline "queue" covers wait AND admission setup
+        job.queue_span = self.state.spans.begin(
+            "queue", component="scheduler", request_id=job.span.request_id
+        )
         with self.cv:
             self.pending.append(job)
             self.state.m_queue_depth.set(len(self.pending))
@@ -332,6 +354,18 @@ class LaneScheduler:
                     self.lane_used[lane] = self._admission_count
                     admissions.append((lane, job))
                 self.state.m_queue_depth.set(len(self.pending))
+            # liveness heartbeat: the watchdog's scheduler-stalled rule
+            # audits the gap between these
+            wd = self.state.watchdog
+            if wd is not None:
+                wd.beat(
+                    n_active=sum(1 for ls in self.lanes if ls is not None),
+                    n_admitting=len(self.admitting),
+                )
+            tick_sp = self.state.spans.begin(
+                "sched_tick", component="scheduler",
+                n_pending=len(self.pending), n_admitting=len(self.admitting),
+            )
             for lane, job in admissions:
                 self._begin_admission(lane, job)
             # stall-free admission: at most ONE bounded prefill chunk per
@@ -372,6 +406,9 @@ class LaneScheduler:
                     for lane in range(len(self.lanes)):
                         if self.lanes[lane] is not None:
                             job = self.lanes[lane].job
+                            self.state.spans.end(
+                                self.lanes[lane].decode_span, error=str(e)
+                            )
                             job.events.put(("error", str(e)))
                             if job.span.finish(
                                 "error", n_completion=job.n_completion
@@ -398,6 +435,7 @@ class LaneScheduler:
                     self._set_lane_gauge()
                     with self.cv:
                         self.cv.notify_all()
+            self.state.spans.end(tick_sp)
             if not any(self.lanes):
                 # decode went idle: the next dispatch starts a new stall
                 # window, don't charge it for the quiet period
@@ -439,6 +477,12 @@ class LaneScheduler:
             qw = job.span.mark_admitted(
                 lane=lane, reused_prefix_tokens=start_pos
             )
+            # the queue span absorbs tokenize+match above, so per-request
+            # timeline coverage only misses inter-tick bookkeeping
+            state.spans.end(
+                job.queue_span, lane=lane, n_prompt=len(tokens),
+                reused_prefix_tokens=start_pos,
+            )
             state.m_queue_wait.observe(qw)
             state.m_admissions.inc()
             seq_len = self.engine.header.seq_len
@@ -466,6 +510,7 @@ class LaneScheduler:
                 adopt_pages=adopt_pages,
             )
         except Exception as e:
+            state.spans.end(job.queue_span, error=str(e))
             job.events.put(("error", str(e)))
             if job.span.finish("error") is not None:
                 state.m_finished.labels(reason="error").inc()
@@ -489,23 +534,47 @@ class LaneScheduler:
             self._abort_admission(lane, "cancelled")
             return
         fills = adm.tokens[:-1]
+        wd = self.state.watchdog
+        rid = job.span.request_id
         try:
             if adm.adopt_pages and not adm.adopted:
                 # the adopt copy is this lane's first tick action and is
                 # its own tick (one bounded engine dispatch per tick, same
                 # budget discipline as a prefill chunk)
+                sp = self.state.spans.begin(
+                    "adopt", component="scheduler", request_id=rid,
+                    lane=lane, n_pages=len(adm.adopt_pages),
+                )
+                if wd is not None:
+                    wd.dispatch_begin("kv_adopt")
                 t0 = self._clock()
-                self.kv.adopt(lane, adm.adopt_pages)
+                try:
+                    self.kv.adopt(lane, adm.adopt_pages)
+                finally:
+                    if wd is not None:
+                        wd.dispatch_end()
+                    self.state.spans.end(sp)
                 adm.prefill_s += self._clock() - t0
                 adm.adopted = True
             elif adm.cursor < len(fills):
-                t0 = self._clock()
-                width = self.engine.prefill_lane_chunk(
-                    lane,
-                    fills[adm.cursor:],
-                    adm.pos0 + adm.cursor,
-                    budget=self.admission_chunk,
+                sp = self.state.spans.begin(
+                    "admission_chunk", component="scheduler",
+                    request_id=rid, lane=lane, pos=adm.pos0 + adm.cursor,
                 )
+                if wd is not None:
+                    wd.dispatch_begin("prefill_lane_chunk")
+                t0 = self._clock()
+                try:
+                    width = self.engine.prefill_lane_chunk(
+                        lane,
+                        fills[adm.cursor:],
+                        adm.pos0 + adm.cursor,
+                        budget=self.admission_chunk,
+                    )
+                finally:
+                    if wd is not None:
+                        wd.dispatch_end()
+                    self.state.spans.end(sp)
                 adm.prefill_s += self._clock() - t0
                 adm.cursor += width
                 adm.n_chunks += 1
@@ -562,6 +631,11 @@ class LaneScheduler:
             top_p=p.top_p,
             seed=p.seed,
             history=list(adm.tokens),
+            decode_span=state.spans.begin(
+                "decode", component="scheduler",
+                request_id=job.span.request_id, lane=lane,
+                n_prompt=len(adm.tokens),
+            ),
         )
         del self.admitting[lane]
         self._set_lane_gauge()
@@ -591,13 +665,22 @@ class LaneScheduler:
 
     def _finish(self, lane: int, reason: str) -> None:
         ls = self.lanes[lane]
+        rid = ls.job.span.request_id
+        self.state.spans.end(
+            ls.decode_span, reason=reason,
+            n_completion=ls.job.n_completion,
+        )
         if self.kv is not None:
             if reason in ("stop", "length"):
                 # publish the fed history's whole pages into the shared
                 # pool BEFORE signalling done, so a client's immediate
                 # follow-up request (any lane) matches this conversation.
                 # Dedup inside publish keeps shared prefixes stored once.
-                self.kv.publish(lane, ls.history[: ls.pos])
+                with self.state.spans.span(
+                    "publish", component="scheduler", request_id=rid,
+                    lane=lane, n_tokens=ls.pos,
+                ):
+                    self.kv.publish(lane, ls.history[: ls.pos])
             # cancelled/errored streams publish nothing; either way the
             # lane's adopted-page retains are released now
             self.kv.release_lane(lane)
@@ -609,6 +692,8 @@ class LaneScheduler:
             self.state.m_finished.labels(reason=reason).inc()
             if reason == "cancelled":
                 self.state.m_cancellations.inc()
+        self.state.slo.observe_span(ls.job.span)
+        self.state.spans.maybe_flush()
         ls.job.events.put(("done", reason))
         self.state.recorder.record(
             "finish", lane=lane, reason=reason, pos=ls.pos,
@@ -642,14 +727,25 @@ class LaneScheduler:
         if self._last_decode_end is not None:
             self.state.m_decode_stall.observe(now - self._last_decode_end)
         t0 = time.perf_counter()
-        rows = self.engine.decode_lanes(
-            tokens, pos, self.block_size, active, temps, topps, seeds=seeds
-        )
+        wd = self.state.watchdog
+        if wd is not None:
+            wd.dispatch_begin("decode_lanes")
+        try:
+            rows = self.engine.decode_lanes(
+                tokens, pos, self.block_size, active, temps, topps,
+                seeds=seeds
+            )
+        finally:
+            if wd is not None:
+                wd.dispatch_end()
         self._last_decode_end = self._clock()
         if rows:
             # every active stream advanced len(rows) tokens in this block
             self.state.m_tpot.observe(
                 (time.perf_counter() - t0) / len(rows)
+            )
+            self.state.slo.note_tokens(
+                len(rows) * sum(1 for a in active if a)
             )
         if not rows:
             for lane in range(b):
@@ -700,6 +796,8 @@ class ApiState:
         admission_chunk: int | None = None,
         kv_page_size: int = 0,
         kv_pool_pages: int = 0,
+        slo_ttft_ms: float | None = None,
+        slo_tpot_ms: float | None = None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
@@ -712,6 +810,13 @@ class ApiState:
         self.obs = get_registry()
         self.recorder = get_recorder()
         self.tracer = tracer if tracer is not None else Tracer()
+        # span timeline (GET /v1/debug/timeline, --timeline-out) and
+        # windowed SLO attainment/goodput (GET /v1/debug/slo)
+        self.spans = get_span_tracker()
+        ttft_ms, tpot_ms = resolve_slo_knobs(slo_ttft_ms, slo_tpot_ms)
+        self.slo = SloTracker(
+            ttft_target_ms=ttft_ms, tpot_target_ms=tpot_ms
+        )
         # analytic per-chip accounting, computed once: /v1/debug/memory
         # compares it against the live device.memory_stats() snapshot
         from ..utils.telemetry import memory_report
@@ -835,6 +940,20 @@ class ApiState:
                 n_pages=kv_pool_pages,
                 evict_counter=self.m_evictions,
             )
+        # engine watchdog audits the scheduler loop; it must exist BEFORE
+        # the scheduler thread starts (the loop beats it every tick). The
+        # decode-stalled threshold scales off the engine's own p99 block
+        # time so slow models don't false-alarm.
+        self.watchdog = None
+        if lanes_on:
+            self.watchdog = EngineWatchdog(
+                block_p99=lambda: engine._m_step.labels(
+                    kind="decode_lanes"
+                ).percentile(0.99),
+                recorder=self.recorder,
+                **resolve_watchdog_knobs(),
+            )
+            self.watchdog.start()
         self.scheduler = (
             LaneScheduler(
                 self,
@@ -941,6 +1060,7 @@ class ApiState:
         t_gen = time.perf_counter()
 
         def on_token(t: int):
+            self.slo.note_tokens(1)
             ttft = span.mark_first_token()
             if ttft is not None:
                 self.m_ttft.observe(ttft)
@@ -1001,6 +1121,8 @@ class ApiState:
             reason, n_prompt=n_prompt_tokens, n_completion=n_completion
         ) is not None:
             self.m_finished.labels(reason=reason).inc()
+            self.slo.observe_span(span)
+            self.spans.maybe_flush()
         return _completion_response(
             self,
             buffer,
@@ -1102,6 +1224,8 @@ _KNOWN_PATHS = frozenset(
         "/v1/debug/memory",
         "/v1/debug/compile",
         "/v1/debug/kv",
+        "/v1/debug/timeline",
+        "/v1/debug/slo",
         "/metrics",
         "/health",
         "/healthz",
@@ -1115,8 +1239,10 @@ def make_handler(state: ApiState):
 
         def _count_request(self) -> None:
             # unknown paths fold into one label so a scanner can't blow up
-            # the metric's cardinality
-            path = self.path if self.path in _KNOWN_PATHS else "other"
+            # the metric's cardinality; query strings don't split series
+            path = self.path.partition("?")[0]
+            if path not in _KNOWN_PATHS:
+                path = "other"
             state.m_http.labels(path=path).inc()
 
         def log_message(self, fmt, *args):  # quiet access log
@@ -1144,7 +1270,11 @@ def make_handler(state: ApiState):
 
         def do_GET(self):
             self._count_request()
-            if self.path == "/v1/models":
+            # /v1/debug/timeline takes ?request_id=...; parse by hand so
+            # the other exact-match branches tolerate stray queries too
+            path, _, query = self.path.partition("?")
+            params = parse_qs(query)
+            if path == "/v1/models":
                 self._json(
                     {
                         "object": "list",
@@ -1158,17 +1288,19 @@ def make_handler(state: ApiState):
                         ],
                     }
                 )
-            elif self.path == "/metrics":
-                # refresh the per-chip memory gauges at scrape time (a
-                # no-op list walk on backends without memory_stats)
+            elif path == "/metrics":
+                # refresh the per-chip memory gauges and the windowed SLO
+                # gauges at scrape time (a no-op list walk on backends
+                # without memory_stats)
                 sample_device_memory(state.obs)
+                state.slo.snapshot()
                 body = state.obs.render().encode("utf-8")
                 self.send_response(200)
                 self.send_header("Content-Type", state.obs.CONTENT_TYPE)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
-            elif self.path == "/v1/health":
+            elif path == "/v1/health":
                 sched = state.scheduler
                 total = state.engine.batch_size if sched is not None else 1
                 if sched is not None:
@@ -1177,25 +1309,31 @@ def make_handler(state: ApiState):
                 else:
                     active = 1 if state.lock.locked() else 0
                     queued = 0
-                self._json(
-                    {
-                        "status": "ok",
-                        "model": state.model_name,
-                        "uptime_s": round(time.time() - state.start_unix, 3),
-                        "lanes": {
-                            "total": total,
-                            "active": active,
-                            "free": total - active,
-                        },
-                        "queue_depth": queued,
-                        "cache_epoch": state.engine.cache_epoch,
-                    }
-                )
-            elif self.path == "/v1/debug/recorder":
+                payload = {
+                    "status": "ok",
+                    "model": state.model_name,
+                    "uptime_s": round(time.time() - state.start_unix, 3),
+                    "lanes": {
+                        "total": total,
+                        "active": active,
+                        "free": total - active,
+                    },
+                    "queue_depth": queued,
+                    "cache_epoch": state.engine.cache_epoch,
+                }
+                wd = state.watchdog
+                if wd is not None and wd.degraded:
+                    # a stalled engine is still accepting connections —
+                    # health says DEGRADED so a probe/router can act on
+                    # the watchdog's verdict
+                    payload["status"] = "degraded"
+                    payload["watchdog"] = wd.status()
+                self._json(payload)
+            elif path == "/v1/debug/recorder":
                 # the engine flight recorder's ring: the last N
                 # dispatches/compiles/epochs/scheduler decisions
                 self._json(state.recorder.dump())
-            elif self.path == "/v1/debug/memory":
+            elif path == "/v1/debug/memory":
                 stats = sample_device_memory(state.obs)
                 mr = state.mem_report
                 self._json(
@@ -1212,7 +1350,7 @@ def make_handler(state: ApiState):
                         ),
                     }
                 )
-            elif self.path == "/v1/debug/kv":
+            elif path == "/v1/debug/kv":
                 # paged-KV pool + radix tree accounting (lane path);
                 # {"enabled": false} when sharing is off or single-lane
                 if state.kv_manager is None:
@@ -1221,14 +1359,22 @@ def make_handler(state: ApiState):
                     payload = state.kv_manager.debug()
                     payload["enabled"] = True
                     self._json(payload)
-            elif self.path == "/v1/debug/compile":
+            elif path == "/v1/debug/compile":
                 self._json(
                     {
                         "programs": state.engine.compile_cache_report(),
                         "cost": state.engine.cost_report(),
                     }
                 )
-            elif self.path in ("/health", "/healthz"):
+            elif path == "/v1/debug/timeline":
+                # Chrome-trace / Perfetto JSON of the span ring; with
+                # ?request_id= it narrows to one request and adds its
+                # millisecond-accounting summary under "dllama"
+                rid = (params.get("request_id") or [None])[0]
+                self._json(state.spans.chrome_trace(request_id=rid))
+            elif path == "/v1/debug/slo":
+                self._json(state.slo.snapshot())
+            elif path in ("/health", "/healthz"):
                 self._json({"status": "ok"})
             else:
                 self.send_error(404, "Not Found")
@@ -1289,9 +1435,18 @@ def make_handler(state: ApiState):
                         kind, payload = job.events.get()
                         if kind == "delta":
                             chunk = _chunk_payload(state, payload, stop=False)
-                            _sse_write(
-                                self.wfile, f"data: {json.dumps(chunk)}\r\n\r\n"
-                            )
+                            # one span per SSE frame: a slow client's
+                            # socket backpressure shows up on the http
+                            # track of the timeline, not as engine time
+                            with state.spans.span(
+                                "sse_flush", component="http",
+                                request_id=job.span.request_id,
+                                lane=job.span.lane,
+                            ):
+                                _sse_write(
+                                    self.wfile,
+                                    f"data: {json.dumps(chunk)}\r\n\r\n",
+                                )
                         elif kind == "error":
                             _sse_write(
                                 self.wfile,
@@ -1423,6 +1578,9 @@ def serve(
     admission_chunk: int | None = None,
     kv_page_size: int | None = None,
     kv_pool_pages: int | None = None,
+    timeline_out: str | None = None,
+    slo_ttft_ms: float | None = None,
+    slo_tpot_ms: float | None = None,
 ):
     block, chunk = resolve_lane_knobs(lane_block_size, admission_chunk)
     page_size, pool_pages = resolve_kv_knobs(kv_page_size, kv_pool_pages)
@@ -1436,12 +1594,26 @@ def serve(
         admission_chunk=chunk,
         kv_page_size=page_size,
         kv_pool_pages=pool_pages,
+        slo_ttft_ms=slo_ttft_ms,
+        slo_tpot_ms=slo_tpot_ms,
     )
     if postmortem_dir:
         # a crashed scheduler loop / engine step dumps the event ring here
         state.recorder.postmortem_dir = postmortem_dir
+    if timeline_out:
+        # throttled Chrome-trace export per finished request, plus an
+        # unconditional flush when the server is closed
+        state.spans.set_sink(timeline_out)
     server = ThreadingHTTPServer((host, port), make_handler(state))
     server.state = state  # tests and callers reach the tracer/registry here
+    if timeline_out:
+        inner_close = server.server_close
+
+        def _close_and_flush():
+            inner_close()
+            state.spans.flush()
+
+        server.server_close = _close_and_flush
     if host in ("0.0.0.0", "127.0.0.1"):
         print(f"Server URL: http://localhost:{port}/v1/")
     return server  # caller runs serve_forever() (tests drive it in a thread)
@@ -1495,6 +1667,9 @@ def main(argv=None) -> None:
                 admission_chunk=args.admission_chunk,
                 kv_page_size=args.kv_page_size,
                 kv_pool_pages=args.kv_pool_pages,
+                timeline_out=args.timeline_out,
+                slo_ttft_ms=args.slo_ttft_ms,
+                slo_tpot_ms=args.slo_tpot_ms,
             )
             server.serve_forever()
             return

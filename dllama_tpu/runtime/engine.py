@@ -188,6 +188,12 @@ class InferenceEngine:
         # dispatches, compiles, cache epochs, errors — in a bounded ring;
         # /v1/debug/recorder dumps it, crashes postmortem it
         self.recorder = get_recorder()
+        # span timelines (obs/spans.py): every dispatch below brackets a
+        # component="engine" span, with a nested ".device" span splitting
+        # host dispatch from device completion on the block-decode paths
+        from ..obs.spans import get_span_tracker
+
+        self._spans = get_span_tracker()
         self._m_step = self.obs.histogram(
             "dllama_engine_step_seconds",
             "Wall time of one engine dispatch (compiled program call + "
@@ -799,6 +805,10 @@ class InferenceEngine:
             "step_dispatch", step="decode_block", pos=pos,
             n_steps=n_steps, window=window,
         )
+        sp = self._spans.begin(
+            "decode_block", component="engine", n_steps=n_steps,
+            pos=pos, window=window,
+        )
         t0 = time.perf_counter()
         with self._cache_guard():
             out, self.cache = block(
@@ -810,8 +820,15 @@ class InferenceEngine:
                 jnp.float32(max(self.temperature, 1e-6)),
                 jnp.float32(self.sampler.topp),
             )
+            # dispatch returned (async); the readback below waits for the
+            # device — the ".device" sub-span is that wait
+            sp_dev = self._spans.begin(
+                "decode_block.device", component="engine"
+            )
             out = np.asarray(out)  # [n_steps, lanes]
+            self._spans.end(sp_dev)
         dt = time.perf_counter() - t0
+        self._spans.end(sp)
         self._m_step.labels(kind="decode_block").observe(dt)
         self._m_tpot.observe(dt / n_steps)
         self.recorder.record(
@@ -1089,6 +1106,10 @@ class InferenceEngine:
             "step_dispatch", step="prefill_lane_chunk", lane=lane, pos=pos0,
             n_tokens=width, bucket=bucket, window=window,
         )
+        sp = self._spans.begin(
+            "prefill_lane_chunk", component="engine", lane=lane,
+            pos=pos0, n_tokens=width, bucket=bucket,
+        )
         t0 = time.perf_counter()
         arr = jax.device_put(
             jnp.asarray(rows, jnp.int32), self._token_sharding
@@ -1097,6 +1118,7 @@ class InferenceEngine:
         with self._cache_guard():
             self.cache = step(self.params, arr, self.cache, pos_arr)
         dt = time.perf_counter() - t0
+        self._spans.end(sp)
         self._m_step.labels(kind="prefill_lane_chunk").observe(dt)
         self.recorder.record(
             "step_complete", step="prefill_lane_chunk", lane=lane, pos=pos0,
@@ -1346,6 +1368,9 @@ class InferenceEngine:
         self.recorder.record(
             "step_dispatch", step="kv_adopt", lane=lane, n_pages=n
         )
+        sp = self._spans.begin(
+            "kv_adopt", component="engine", lane=lane, n_pages=n
+        )
         t0 = time.perf_counter()
         for start, bucket in self._kv_copy_chunks(n):
             fn = self._kv_copy_fn("adopt", bucket)
@@ -1356,6 +1381,7 @@ class InferenceEngine:
                     jnp.int32(lane), jnp.int32(start), ids,
                 )
         dt = time.perf_counter() - t0
+        self._spans.end(sp)
         self._m_step.labels(kind="kv_adopt").observe(dt)
         self.recorder.record(
             "step_complete", step="kv_adopt", lane=lane, n_pages=n,
@@ -1385,6 +1411,10 @@ class InferenceEngine:
             "step_dispatch", step="kv_publish", lane=lane, n_pages=n,
             start_page=start_page,
         )
+        sp = self._spans.begin(
+            "kv_publish", component="engine", lane=lane, n_pages=n,
+            start_page=start_page,
+        )
         t0 = time.perf_counter()
         for off, bucket in self._kv_copy_chunks(n):
             fn = self._kv_copy_fn("publish", bucket)
@@ -1395,6 +1425,7 @@ class InferenceEngine:
                     jnp.int32(lane), jnp.int32(start_page + off), ids,
                 )
         dt = time.perf_counter() - t0
+        self._spans.end(sp)
         self._m_step.labels(kind="kv_publish").observe(dt)
         self.recorder.record(
             "step_complete", step="kv_publish", lane=lane, n_pages=n,
@@ -1580,6 +1611,10 @@ class InferenceEngine:
             "step_dispatch", step="decode_lanes", pos=deepest,
             n_steps=n_steps, window=window, n_live=len(live),
         )
+        sp = self._spans.begin(
+            "decode_lanes", component="engine", n_steps=n_steps,
+            pos=deepest, n_live=len(live), window=window,
+        )
         t0 = time.perf_counter()
         with self._cache_guard():
             out, self.cache = block(
@@ -1592,8 +1627,16 @@ class InferenceEngine:
                 jnp.asarray(temperature, jnp.float32),
                 jnp.asarray(topp, jnp.float32),
             )
+            # the call above returned as soon as the program was enqueued;
+            # the readback is the device-complete wait — split it out so a
+            # timeline shows dispatch overhead vs device time
+            sp_dev = self._spans.begin(
+                "decode_lanes.device", component="engine"
+            )
             out_np = np.asarray(out)
+            self._spans.end(sp_dev)
         dt = time.perf_counter() - t0
+        self._spans.end(sp)
         self._m_step.labels(kind="decode_lanes").observe(dt)
         # each active stream advances one token per block row
         self._m_tpot.observe(dt / n_steps)
@@ -1671,6 +1714,9 @@ class InferenceEngine:
                 "step_dispatch", step="prefill", pos=p,
                 bucket=bucket, window=window,
             )
+            sp = self._spans.begin(
+                "prefill", component="engine", pos=p, bucket=bucket,
+            )
             t0 = time.perf_counter()
             # Padding tokens write garbage into cache slots [p+width,
             # p+bucket) — harmless: the causal mask hides them until real
@@ -1685,6 +1731,7 @@ class InferenceEngine:
                 ck = ck.q if hasattr(ck, "q") else ck
                 np.asarray(jax.device_get(ck[0, 0, 0, 0, 0]))
             chunk_ms = (time.perf_counter() - t0) * 1000
+            self._spans.end(sp)
             total_ms += chunk_ms
             self.recorder.record(
                 "step_complete", step="prefill", pos=p,
@@ -1716,11 +1763,15 @@ class InferenceEngine:
         self.recorder.record(
             "step_dispatch", step="decode_step", pos=pos, window=window
         )
+        sp = self._spans.begin(
+            "decode_step", component="engine", pos=pos, window=window
+        )
         t0 = time.perf_counter()
         with self._cache_guard():
             out, self.cache = step(self.params, arr, self.cache, jnp.int32(pos))
             out = jax.block_until_ready(out)
         ms = (time.perf_counter() - t0) * 1000
+        self._spans.end(sp)
         self.recorder.record(
             "step_complete", step="decode_step", pos=pos, window=window,
             ms=round(ms, 3),
@@ -1728,7 +1779,10 @@ class InferenceEngine:
         if greedy:
             next_token = int(np.asarray(out)[0])
         else:
-            next_token = self.sampler.sample(np.asarray(out)[0])
+            # the one host-side sampling site left (block decode samples
+            # on-device inside the compiled program)
+            with self._spans.span("sample", component="engine", pos=pos):
+                next_token = self.sampler.sample(np.asarray(out)[0])
         return next_token, StepStats(time_ms=ms, n_tokens=1)
 
     def generate(

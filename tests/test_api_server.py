@@ -1000,3 +1000,122 @@ def test_scheduler_error_writes_postmortem(obs_server, tmp_path):
         "max_tokens": 4, "temperature": 0,
     }) as r:
         assert json.loads(r.read())["object"] == "chat.completion"
+
+
+def test_debug_timeline_endpoint_and_coverage(obs_server):
+    """A finished request's span timeline is served as Chrome-trace JSON
+    and its phase accounting covers >=95% of the request's wall time (the
+    tentpole acceptance bar: queue + admission + decode + publish spans
+    leave only scheduler-tick bookkeeping uncovered)."""
+    with _post(_url(obs_server), {
+        "messages": [{"role": "user", "content": "time me"}],
+        "max_tokens": 6, "temperature": 0,
+    }) as r:
+        body = json.loads(r.read())
+    rid = body["dllama"]["request_id"]
+
+    trace = _get_json(obs_server, f"/v1/debug/timeline?request_id={rid}")
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert xs, "no spans for the request"
+    names = {e["name"] for e in xs}
+    assert "queue" in names and "decode" in names
+    assert all(e["args"]["request_id"] == rid for e in xs)
+    assert all(e["dur"] >= 0 for e in xs)
+    summary = trace["dllama"]["summary"]
+    assert summary["request_id"] == rid
+    assert summary["wall_ms"] > 0
+    assert summary["coverage"] >= 0.95, summary
+    assert "queue" in summary["phases"] and "decode" in summary["phases"]
+    # phase totals are consistent with the span list
+    assert summary["n_spans"] == len(xs)
+
+    # the unfiltered timeline aggregates every component's spans
+    full = _get_json(obs_server, "/v1/debug/timeline")
+    assert full["dllama"]["n_spans"] >= len(xs)
+    comps = {e["args"]["name"]
+             for e in full["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"scheduler", "engine"} <= comps
+
+
+def test_debug_slo_endpoint_and_gauges(obs_server):
+    """/v1/debug/slo serves the three sliding windows with finite
+    attainment/goodput, and the scrape-time snapshot refreshes the
+    dllama_slo_* gauges in /metrics."""
+    with _post(_url(obs_server), {
+        "messages": [{"role": "user", "content": "meet my slo"}],
+        "max_tokens": 4, "temperature": 0,
+    }) as r:
+        r.read()
+    snap = _get_json(obs_server, "/v1/debug/slo")
+    assert set(snap["targets"]) == {"ttft_ms", "tpot_ms"}
+    assert set(snap["windows"]) == {"10s", "1m", "5m"}
+    for w in snap["windows"].values():
+        assert w["n_requests"] >= 0
+        assert 0.0 <= w["attainment"] <= 1.0
+        assert 0.0 <= w["ttft_attainment"] <= 1.0
+        assert w["goodput_tokens_per_s"] >= 0.0
+        assert w["throughput_tokens_per_s"] >= 0.0
+    # the request we just finished is inside the 5m window
+    assert snap["windows"]["5m"]["n_requests"] >= 1
+    assert snap["windows"]["5m"]["throughput_tokens_per_s"] > 0
+
+    _, text = _scrape(obs_server)
+    for fam in ("dllama_slo_attainment", "dllama_slo_ttft_attainment",
+                "dllama_slo_tpot_attainment",
+                "dllama_slo_goodput_tokens_per_s",
+                "dllama_slo_throughput_tokens_per_s",
+                "dllama_slo_window_requests"):
+        assert f"# TYPE {fam} " in text, fam
+    assert re.search(
+        r'^dllama_slo_window_requests\{window="5m"\} \d+$', text, re.M)
+
+
+def test_watchdog_trips_on_injected_stall(obs_server, tmp_path):
+    """A dispatch left hanging past the timeout (driven by a fake clock,
+    so the test is fast) flips /v1/health to degraded, increments
+    dllama_watchdog_stalls_total, and writes a watchdog postmortem; when
+    the dispatch clears the watchdog recovers."""
+    wd = obs_server.state.watchdog
+    assert wd is not None, "lane server must run a watchdog"
+    pm_dir = tmp_path / "pm"
+    old_dir = wd.recorder.postmortem_dir
+    old_clock = wd._clock
+    fake = {"t": 10_000.0}
+    stalls = wd.m_stalls.labels(reason="dispatch-hung")
+    b_stalls = stalls.value
+    try:
+        wd.recorder.postmortem_dir = str(pm_dir)
+        wd._clock = lambda: fake["t"]
+        wd.dispatch_begin("decode_lanes")  # ...and never ends: a hang
+        fake["t"] += wd.dispatch_timeout_s + 1.0
+        assert wd.check_once() == "dispatch-hung"
+        assert wd.degraded
+
+        health = _get_json(obs_server, "/v1/health")
+        assert health["status"] == "degraded"
+        assert health["watchdog"]["degraded"] is True
+        assert health["watchdog"]["reason"] == "dispatch-hung"
+        assert "decode_lanes" in health["watchdog"]["detail"]
+        assert stalls.value == b_stalls + 1
+        _, text = _scrape(obs_server)
+        assert "dllama_watchdog_degraded 1" in text
+
+        files = sorted(pm_dir.glob("postmortem-*.json"))
+        assert files, "watchdog stall never wrote a postmortem"
+        payload = json.loads(files[-1].read_text())
+        assert payload["reason"] == "watchdog"
+        assert "dispatch-hung" in payload["error"]
+
+        # the dispatch completes: one check later the episode is over
+        wd.dispatch_end()
+        assert wd.check_once() is None
+        assert not wd.degraded
+        assert _get_json(obs_server, "/v1/health")["status"] == "ok"
+        # edge-triggered: the whole episode cost exactly one postmortem
+        assert len(sorted(pm_dir.glob("postmortem-*.json"))) == 1
+    finally:
+        wd.dispatch_end()
+        wd._clock = old_clock
+        wd.recorder.postmortem_dir = old_dir
+        wd.check_once()  # clear any degraded state with the real clock

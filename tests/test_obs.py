@@ -549,3 +549,104 @@ def test_replicated_keys_match_param_spec_tree():
                 if leaf_spec == P():
                     replicated.add(key)
     assert replicated == _REPLICATED_KEYS
+
+
+# -- histogram percentile (watchdog stall thresholds ride on this) -----------
+
+
+def test_histogram_percentile_interpolation():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_pct_seconds", "pct", buckets=(1.0, 2.0, 4.0))
+    assert h.percentile(0.5) is None  # no observations yet
+    for v in (0.5, 0.5, 0.5, 0.5, 1.5, 1.5, 1.5, 1.5, 3.0, 3.0):
+        h.observe(v)
+    # 10 samples: 4 in (0,1], 4 in (1,2], 2 in (2,4]; linear interpolation
+    # within the landing bucket, first bucket's lower edge is 0.0
+    assert h.percentile(0.0) == 0.0
+    assert h.percentile(0.4) == 1.0  # exactly exhausts the first bucket
+    assert h.percentile(0.5) == pytest.approx(1.25)
+    assert h.percentile(0.8) == pytest.approx(2.0)
+    assert h.percentile(0.9) == pytest.approx(3.0)
+    assert h.percentile(1.0) == 4.0
+    with pytest.raises(ValueError):
+        h.percentile(1.5)
+    with pytest.raises(ValueError):
+        h.percentile(-0.1)
+
+
+def test_histogram_percentile_edge_buckets():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_pct_edge_seconds", "pct", buckets=(1.0, 2.0, 4.0))
+    h.observe(1.5)
+    h.observe(1.5)
+    # target 0 lands in the empty first bucket -> its upper edge
+    assert h.percentile(0.0) == 1.0
+    assert h.percentile(1.0) == 2.0
+    # overflow-only data clamps to the largest finite edge (the +Inf
+    # bucket has no finite upper bound to interpolate toward)
+    h2 = reg.histogram("t_pct_inf_seconds", "pct", buckets=(1.0, 2.0, 4.0))
+    h2.observe(100.0)
+    assert h2.percentile(0.5) == 4.0
+
+
+def test_histogram_percentile_labeled_child():
+    reg = MetricsRegistry()
+    fam = reg.histogram(
+        "t_pct_lbl_seconds", "pct", labelnames=("kind",), buckets=(1.0, 2.0)
+    )
+    fam.labels(kind="decode").observe(0.5)
+    # one sample in (0,1]: p100 interpolates to the bucket's upper edge
+    assert fam.labels(kind="decode").percentile(1.0) == 1.0
+    assert fam.labels(kind="decode").percentile(0.5) == pytest.approx(0.5)
+    assert fam.labels(kind="other").percentile(0.5) is None
+
+
+# -- tracer serialization fallback + sink-error event ------------------------
+
+
+def test_tracer_sink_survives_nonserializable_attrs(tmp_path):
+    sink = str(tmp_path / "trace.jsonl")
+    tr = Tracer(capacity=4, sink_path=sink)
+    tr.record({"request_id": "r1", "err": ValueError("boom")})
+    tr.close()
+    (rec,) = read_jsonl(sink)
+    assert rec["request_id"] == "r1"
+    assert rec["err"] == repr(ValueError("boom"))  # degraded, not dropped
+
+
+def test_tracer_export_survives_nonserializable_attrs(tmp_path):
+    tr = Tracer(capacity=4)
+    tr.record({"request_id": "r1", "obj": object()})
+    out = str(tmp_path / "export.jsonl")
+    assert tr.export(out) == 1
+    (rec,) = read_jsonl(out)
+    assert rec["obj"].startswith("<object object")
+
+
+def test_dumps_safe_circular_structure():
+    from dllama_tpu.obs.trace import _dumps_safe
+
+    d = {"request_id": "r1"}
+    d["self"] = d  # json.dumps raises ValueError even with default=repr
+    rec = json.loads(_dumps_safe(d))
+    assert "_unserializable" in rec
+
+
+def test_tracer_sink_write_error_records_event(tmp_path):
+    from dllama_tpu.obs.recorder import get_recorder
+
+    sink = str(tmp_path / "trace.jsonl")
+    tr = Tracer(capacity=4, sink_path=sink)
+    tr._sink.close()  # simulate the fd dying under the tracer
+    before = len(get_recorder().events("obs_sink_error"))
+    tr.record({"request_id": "r1"})
+    evs = get_recorder().events("obs_sink_error")
+    assert len(evs) == before + 1
+    assert evs[-1]["what"] == "trace_jsonl"
+    assert evs[-1]["path"] == sink
+    assert evs[-1]["error_type"] == "ValueError"
+    # the sink is dropped, the ring keeps serving, no second event
+    assert tr._sink is None
+    tr.record({"request_id": "r2"})
+    assert len(get_recorder().events("obs_sink_error")) == before + 1
+    assert [r["request_id"] for r in tr.records()] == ["r1", "r2"]

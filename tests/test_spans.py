@@ -1,0 +1,329 @@
+"""obs/spans.py + obs/slo.py + obs/watchdog.py unit tests.
+
+Pure-Python (no engine) against injected fake clocks, local registries
+and local flight recorders, so they ride the fast CI lane and are
+deterministic: the span math, the window math and every watchdog stall
+rule are driven by hand-advanced time, never by sleeps.
+"""
+
+import json
+import os
+
+import pytest
+
+from dllama_tpu.obs.metrics import MetricsRegistry
+from dllama_tpu.obs.recorder import FlightRecorder
+from dllama_tpu.obs.slo import SloTracker, resolve_slo_knobs
+from dllama_tpu.obs.spans import SpanTracker
+from dllama_tpu.obs.watchdog import EngineWatchdog
+
+pytestmark = pytest.mark.fast
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# -- SpanTracker -------------------------------------------------------------
+
+
+def test_span_lifecycle_and_attrs():
+    clk = FakeClock()
+    st = SpanTracker(capacity=16, enabled=True, clock=clk)
+    h = st.begin("queue", component="scheduler", request_id="r1", lane=2,
+                 n_prompt=7)
+    clk.t = 0.25
+    st.end(h, reused=3)
+    (s,) = st.completed()
+    assert s["name"] == "queue"
+    assert s["component"] == "scheduler"
+    assert s["request_id"] == "r1"
+    assert s["lane"] == 2
+    assert s["t0"] == 0.0
+    assert s["dur_s"] == 0.25
+    assert s["attrs"] == {"n_prompt": 7, "reused": 3}
+    # idempotent end: the error path racing the normal one records once
+    st.end(h)
+    assert len(st.completed()) == 1
+    assert st.completed(request_id="nope") == []
+
+
+def test_span_context_manager_records_on_raise():
+    clk = FakeClock()
+    st = SpanTracker(capacity=4, enabled=True, clock=clk)
+    with pytest.raises(RuntimeError):
+        with st.span("chunk", request_id="r1"):
+            clk.t = 1.5
+            raise RuntimeError("engine died")
+    (s,) = st.completed()
+    assert s["dur_s"] == 1.5  # the error still took the time
+
+
+def test_span_disabled_is_noop():
+    st = SpanTracker(capacity=4, enabled=False)
+    assert st.begin("x") is None
+    st.end(None)  # call sites never branch on enablement
+    with st.span("y") as h:
+        assert h is None
+    assert st.completed() == []
+    assert st.total_recorded == 0
+
+
+def test_span_ring_overflow_records_event():
+    rec = FlightRecorder(capacity=64)
+    st = SpanTracker(capacity=2, enabled=True, recorder=rec)
+    for _ in range(3):
+        st.end(st.begin("s"))
+    assert st.total_recorded == 3
+    assert st.dropped == 1
+    evs = rec.events("obs_overflow")
+    assert len(evs) == 1  # first drop fires...
+    assert evs[0]["what"] == "span_ring"
+    for _ in range(2):
+        st.end(st.begin("s"))
+    assert st.dropped == 3  # ...then every `capacity` further drops
+    assert len(rec.events("obs_overflow")) == 2
+
+
+def test_chrome_trace_shape_and_roundtrip(tmp_path):
+    clk = FakeClock()
+    st = SpanTracker(capacity=16, enabled=True, clock=clk)
+    h = st.begin("queue", component="scheduler", request_id="r1", lane=0)
+    clk.t = 0.001
+    st.end(h)
+    h = st.begin("decode_lanes", component="engine")
+    clk.t = 0.003
+    st.end(h)
+    trace = st.chrome_trace()
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    ms = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert len(xs) == 2
+    # pid = component, tid = lane (-1 = no lane), ts/dur in microseconds
+    q = next(e for e in xs if e["name"] == "queue")
+    assert q["ts"] == 0.0 and q["dur"] == 1000.0 and q["tid"] == 0
+    d = next(e for e in xs if e["name"] == "decode_lanes")
+    assert d["tid"] == -1 and d["pid"] != q["pid"]
+    names = {(e["name"], e["args"]["name"]) for e in ms}
+    assert ("process_name", "scheduler") in names
+    assert ("process_name", "engine") in names
+    # the export is plain JSON a viewer can load back
+    path = os.path.join(tmp_path, "tl.json")
+    assert st.export_file(path) == 2
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["dllama"]["n_spans"] == 2
+
+
+def test_request_summary_coverage_and_phases():
+    clk = FakeClock()
+    st = SpanTracker(capacity=16, enabled=True, clock=clk)
+
+    def record(name, t0, t1, rid="r1"):
+        clk.t = t0
+        h = st.begin(name, request_id=rid)
+        clk.t = t1
+        st.end(h)
+
+    record("queue", 0.0, 1.0)
+    record("decode", 1.0, 3.0)
+    record("sample", 1.5, 2.5)  # nested: must not double-count coverage
+    record("other", 0.0, 9.0, rid="r2")  # another request: excluded
+    s = st.request_summary("r1")
+    assert s["n_spans"] == 3
+    assert s["wall_ms"] == 3000.0
+    assert s["covered_ms"] == 3000.0
+    assert s["coverage"] == 1.0
+    assert s["phases"]["queue"]["total_ms"] == 1000.0
+    assert s["phases"]["queue"]["share"] == round(1 / 3, 4)
+    assert s["phases"]["decode"]["total_ms"] == 2000.0
+    # a gap between spans is uncovered wall time
+    record("a", 10.0, 11.0, rid="r3")
+    record("b", 12.0, 13.0, rid="r3")
+    s3 = st.request_summary("r3")
+    assert s3["wall_ms"] == 3000.0
+    assert s3["covered_ms"] == 2000.0
+    assert s3["coverage"] == round(2 / 3, 4)
+    assert st.request_summary("missing")["coverage"] is None
+
+
+# -- SloTracker --------------------------------------------------------------
+
+
+def test_slo_windows_attainment_and_goodput():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    slo = SloTracker(ttft_target_ms=100.0, registry=reg, clock=clk)
+    clk.t = 1.0
+    assert slo.observe_request(ttft_s=0.05, tpot_s=None, n_tokens=20)
+    slo.note_tokens(20)
+    clk.t = 5.0
+    assert not slo.observe_request(ttft_s=0.2, tpot_s=None, n_tokens=30)
+    slo.note_tokens(30)
+    clk.t = 9.0
+    snap = slo.snapshot()
+    w10 = snap["windows"]["10s"]
+    assert w10["n_requests"] == 2 and w10["n_met"] == 1
+    assert w10["ttft_attainment"] == 0.5
+    assert w10["attainment"] == 0.5
+    # goodput counts ONLY the SLO-met request's tokens; throughput all
+    assert w10["goodput_tokens_per_s"] == round(20 / 10.0, 3)
+    assert w10["throughput_tokens_per_s"] == round(50 / 10.0, 3)
+    # both requests age out of 10s/1m but stay inside 5m
+    clk.t = 100.0
+    snap = slo.snapshot()
+    assert snap["windows"]["10s"]["n_requests"] == 0
+    assert snap["windows"]["10s"]["attainment"] == 1.0  # vacuous, finite
+    assert snap["windows"]["10s"]["goodput_tokens_per_s"] == 0.0
+    assert snap["windows"]["1m"]["n_requests"] == 0
+    assert snap["windows"]["5m"]["n_requests"] == 2
+    text = reg.render()
+    assert 'dllama_slo_ttft_attainment{window="10s"} 1' in text
+    assert 'dllama_slo_window_requests{window="5m"} 2' in text
+
+
+def test_slo_tpot_target_and_unset_targets():
+    clk = FakeClock()
+    slo = SloTracker(tpot_target_ms=50.0, registry=MetricsRegistry(),
+                     clock=clk)
+    assert slo.observe_request(ttft_s=99.0, tpot_s=0.01)  # no TTFT target
+    assert not slo.observe_request(ttft_s=0.01, tpot_s=0.2)
+    none_set = SloTracker(registry=MetricsRegistry(), clock=clk)
+    assert none_set.observe_request(ttft_s=None, tpot_s=None)  # vacuous
+
+
+def test_slo_observe_span():
+    class Span:
+        finish_reason = "stop"
+        n_completion = 11
+        ttft_s = 0.05
+        total_s = 1.05
+        queue_wait_s = 0.01
+
+    clk = FakeClock(t=1.0)
+    slo = SloTracker(ttft_target_ms=100.0, tpot_target_ms=200.0,
+                     registry=MetricsRegistry(), clock=clk)
+    # tpot = (1.05 - 0.05) / 10 = 0.1s <= 200ms
+    assert slo.observe_span(Span()) is True
+    cancelled = Span()
+    cancelled.finish_reason = "cancelled"
+    assert slo.observe_span(cancelled) is None  # says nothing about SLOs
+    assert slo.snapshot()["windows"]["10s"]["n_requests"] == 1
+
+
+def test_slo_knob_resolution(monkeypatch):
+    monkeypatch.delenv("DLLAMA_SLO_TTFT_MS", raising=False)
+    monkeypatch.delenv("DLLAMA_SLO_TPOT_MS", raising=False)
+    assert resolve_slo_knobs() == (None, None)
+    monkeypatch.setenv("DLLAMA_SLO_TTFT_MS", "250")
+    monkeypatch.setenv("DLLAMA_SLO_TPOT_MS", "40")
+    assert resolve_slo_knobs() == (250.0, 40.0)
+    # explicit beats env, same precedence as the lane knobs
+    assert resolve_slo_knobs(ttft_ms=500.0) == (500.0, 40.0)
+
+
+# -- EngineWatchdog ----------------------------------------------------------
+
+
+def _watchdog(tmp_path, clk, **kw):
+    reg = MetricsRegistry()
+    rec = FlightRecorder(capacity=64, postmortem_dir=str(tmp_path))
+    wd = EngineWatchdog(clock=clk, registry=reg, recorder=rec, **kw)
+    return wd, reg, rec
+
+
+def test_watchdog_dispatch_hung_postmortem_and_recovery(tmp_path):
+    clk = FakeClock()
+    wd, reg, rec = _watchdog(tmp_path, clk, dispatch_timeout_s=30.0)
+    # n_active=0 keeps the decode-gap rule disarmed so only the in-flight
+    # dispatch's age can trip the watchdog here
+    wd.beat(n_active=0)
+    wd.dispatch_begin("decode_lanes")
+    clk.t = 10.0
+    assert wd.check_once() is None
+    clk.t = 31.0
+    assert wd.check_once() == "dispatch-hung"
+    assert wd.degraded
+    assert wd.status()["reason"] == "dispatch-hung"
+    assert "decode_lanes" in wd.status()["detail"]
+    text = reg.render()
+    assert "dllama_watchdog_degraded 1" in text
+    assert 'dllama_watchdog_stalls_total{reason="dispatch-hung"} 1' in text
+    # the hang wrote the black box while the process is still alive
+    pms = [p for p in os.listdir(tmp_path) if p.startswith("postmortem-")]
+    assert len(pms) == 1
+    payload = json.loads((tmp_path / pms[0]).read_text())
+    assert payload["reason"] == "watchdog"
+    assert "dispatch-hung" in payload["error"]
+    # edge-triggered: re-checks while stalled pay nothing further
+    clk.t = 32.0
+    assert wd.check_once() == "dispatch-hung"
+    assert len(rec.events("watchdog_stall")) == 1
+    assert len(
+        [p for p in os.listdir(tmp_path) if p.startswith("postmortem-")]
+    ) == 1
+    # recovery clears degraded and records the transition
+    wd.dispatch_end()
+    wd.beat(n_active=0)
+    assert wd.check_once() is None
+    assert not wd.degraded
+    assert rec.events("watchdog_recovered")[0]["reason"] == "dispatch-hung"
+    assert "dllama_watchdog_degraded 0" in reg.render()
+
+
+def test_watchdog_scheduler_stalled(tmp_path):
+    clk = FakeClock()
+    wd, _, rec = _watchdog(tmp_path, clk, dispatch_timeout_s=30.0)
+    wd.beat(n_active=2)
+    clk.t = 31.0
+    assert wd.check_once() == "scheduler-stalled"
+    # an idle scheduler (no busy lanes) is quiet, not stalled
+    wd2, _, _ = _watchdog(tmp_path, clk, dispatch_timeout_s=30.0)
+    wd2.beat(n_active=0, n_admitting=0)
+    clk.t = 100.0
+    assert wd2.check_once() is None
+
+
+def test_watchdog_decode_stalled_scales_with_p99(tmp_path):
+    clk = FakeClock()
+    wd, _, _ = _watchdog(
+        tmp_path, clk, min_stall_s=5.0, stall_factor=20.0,
+        block_p99=lambda: 1.0,
+    )
+    wd.beat(n_active=1)  # arms the decode-gap rule from t=0
+    clk.t = 6.0
+    wd.beat(n_active=1)
+    # gap 6s > min_stall but < 20 x p99(1s): a slow model, not a stall
+    assert wd.check_once() is None
+    clk.t = 21.0
+    wd.beat(n_active=1)
+    assert wd.check_once() == "decode-stalled"
+
+
+def test_watchdog_decode_stalled_min_floor_without_p99(tmp_path):
+    clk = FakeClock()
+    wd, _, _ = _watchdog(tmp_path, clk, min_stall_s=5.0)
+    wd.beat(n_active=1)
+    clk.t = 6.0
+    wd.beat(n_active=1)  # fresh beat; decode gap is the stale signal
+    assert wd.check_once() == "decode-stalled"
+
+
+def test_watchdog_admission_stalled_and_progress_resets(tmp_path):
+    clk = FakeClock()
+    wd, _, _ = _watchdog(tmp_path, clk, dispatch_timeout_s=30.0)
+    wd.beat(n_admitting=1)
+    clk.t = 20.0
+    wd.beat(n_admitting=1)
+    # a chunk completed: progress timestamp moves, no stall at t=31
+    wd.dispatch_begin("prefill_lane_chunk")
+    wd.dispatch_end()
+    clk.t = 31.0
+    wd.beat(n_admitting=1)
+    assert wd.check_once() is None
+    clk.t = 51.0
+    wd.beat(n_admitting=1)
+    assert wd.check_once() == "admission-stalled"
