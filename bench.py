@@ -283,7 +283,9 @@ def _serving_smoke(n_clients: int) -> dict:
         admission_chunk=32, slo_ttft_ms=60000.0, slo_tpot_ms=5000.0,
     )
     port = srv.server_address[1]
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    threading.Thread(  # dlint: disable=thread-hygiene — serve_forever exits at srv.shutdown() below; no handle needed
+        target=srv.serve_forever, daemon=True, name="dllama-bench-http"
+    ).start()
 
     def one_request(i: int) -> None:
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
@@ -300,7 +302,10 @@ def _serving_smoke(n_clients: int) -> dict:
         conn.close()
 
     threads = [
-        threading.Thread(target=one_request, args=(i,))
+        threading.Thread(
+            target=one_request, args=(i,), daemon=True,
+            name=f"dllama-bench-client-{i}",
+        )
         for i in range(n_clients)
     ]
     for t in threads:
@@ -347,7 +352,9 @@ def _serving_smoke(n_clients: int) -> dict:
                 first_delta.set()
         conn.close()
 
-    vt = threading.Thread(target=victim_request)
+    vt = threading.Thread(
+        target=victim_request, daemon=True, name="dllama-bench-victim"
+    )
     vt.start()
     first_delta.wait(timeout=120)
     pre_churn = scrape_metrics()  # victim admitted; churn not started
@@ -369,7 +376,11 @@ def _serving_smoke(n_clients: int) -> dict:
         conn.close()
 
     churners = [
-        threading.Thread(target=churn_request, args=(i,)) for i in range(2)
+        threading.Thread(
+            target=churn_request, args=(i,), daemon=True,
+            name=f"dllama-bench-churn-{i}",
+        )
+        for i in range(2)
     ]
     for t in churners:
         t.start()
@@ -419,7 +430,10 @@ def _serving_smoke(n_clients: int) -> dict:
         one(0, warm)  # publishes the shared prefix; not timed
         outs: dict = {}
         ths = [
-            threading.Thread(target=one, args=(i, outs))
+            threading.Thread(
+                target=one, args=(i, outs), daemon=True,
+                name=f"dllama-bench-fanout-{i}",
+            )
             for i in range(1, fanout_n + 1)
         ]
         for t in ths:
@@ -496,7 +510,9 @@ def _serving_smoke(n_clients: int) -> dict:
         kv_page_size=-1,
     )
     port_off = srv_off.server_address[1]
-    threading.Thread(target=srv_off.serve_forever, daemon=True).start()
+    threading.Thread(  # dlint: disable=thread-hygiene — serve_forever exits at srv_off.shutdown() below; no handle needed
+        target=srv_off.serve_forever, daemon=True, name="dllama-bench-http-off"
+    ).start()
     ttft_off = fanout_round(port_off)
     srv_off.shutdown()
 
@@ -670,7 +686,9 @@ def _device_watchdog(timeout_s: float = 180.0) -> None:
         finally:
             done.set()
 
-    t = threading.Thread(target=probe, daemon=True)
+    t = threading.Thread(  # dlint: disable=thread-hygiene — a wedged device probe can block forever; the daemon thread is deliberately abandoned after done.wait times out
+        target=probe, daemon=True, name="dllama-device-probe"
+    )
     t.start()
     done.wait(timeout_s)
     if not result.get("ok"):

@@ -1,0 +1,58 @@
+"""dlint: project-native static analysis for the serving path.
+
+PR 6's review caught two silent cross-request KV-corruption races only
+by careful human reading — exactly the class of lock-discipline and
+refcount-pairing bug a project-aware static pass flags mechanically.
+This package is that pass: an AST lint framework with rules written
+against THIS codebase's conventions (``self._lock`` guarding, the
+``PagePool.retain``/``release`` ownership protocol, injectable clocks,
+JAX trace purity, thread hygiene, the metrics↔docs contract).
+
+One entrypoint runs everything::
+
+    python -m dllama_tpu.analysis            # lint the repo, exit 0/1
+    python -m dllama_tpu.analysis --list-rules
+    python -m dllama_tpu.analysis --update-baseline
+
+Per-line suppressions use ``# dlint: disable=<rule>[,<rule>] — reason``
+on the offending line; pre-existing findings can instead live in the
+checked-in baseline (``dlint-baseline.json``), which CI treats as the
+only findings allowed to exist. See docs/static_analysis.md.
+
+The runtime half of the tooling — a test-mode lock wrapper that records
+the cross-thread lock acquisition-order graph and fails on cycles, plus
+a deterministic seeded interleaving harness — lives in
+:mod:`dllama_tpu.analysis.lockwatch`.
+"""
+
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    Finding,
+    Repo,
+    Rule,
+    SourceModule,
+    load_baseline,
+    run_rules,
+    write_baseline,
+)
+
+
+def all_rules() -> list:
+    """Every registered rule, instantiated (import-cycle-free accessor:
+    rule modules import core, never the other way around)."""
+    from .rules_clock import DirectClockRule
+    from .rules_kv import RetainReleaseRule
+    from .rules_locks import GuardedAttrsRule
+    from .rules_metrics import MetricsDocsRule
+    from .rules_threads import ThreadHygieneRule
+    from .rules_trace import TracePurityRule
+
+    return [
+        GuardedAttrsRule(),
+        RetainReleaseRule(),
+        DirectClockRule(),
+        TracePurityRule(),
+        ThreadHygieneRule(),
+        MetricsDocsRule(),
+    ]

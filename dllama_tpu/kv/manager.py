@@ -25,8 +25,9 @@ readers.
 from __future__ import annotations
 
 import logging
-import threading
+from typing import Any
 
+from ..analysis.lockwatch import make_lock
 from ..obs.metrics import get_registry
 from ..obs.recorder import get_recorder
 from ..obs.spans import get_span_tracker
@@ -41,11 +42,11 @@ DEFAULT_PAGE_SIZE = 16
 class PagedKVManager:
     def __init__(
         self,
-        engine,
+        engine: Any,
         page_size: int = 0,
         n_pages: int = 0,
-        evict_counter=None,
-    ):
+        evict_counter: Any = None,
+    ) -> None:
         self.engine = engine
         self.page_size = page_size or DEFAULT_PAGE_SIZE
         n = engine.init_kv_pool(self.page_size, n_pages)
@@ -55,7 +56,7 @@ class PagedKVManager:
         self.spans = get_span_tracker()
         self.pool = PagePool(n, self.page_size, on_event=self._pool_event)
         self.tree = RadixTree(self.page_size)
-        self.lock = threading.Lock()
+        self.lock = make_lock("kv.manager")
         self._lane_pages: dict[int, list[int]] = {}
         # dashboards keep their dllama_cache_evictions_total series: the
         # ApiState hands us its handle and radix evictions feed it

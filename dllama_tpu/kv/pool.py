@@ -43,7 +43,7 @@ class PagePool:
         n_pages: int,
         page_size: int,
         on_event: Optional[Callable[[str, dict], None]] = None,
-    ):
+    ) -> None:
         if n_pages < 2:
             raise ValueError(f"PagePool needs >= 2 pages (1 is scratch), got {n_pages}")
         if page_size < 1:

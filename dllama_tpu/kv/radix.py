@@ -21,7 +21,10 @@ itself (refcount 1 in the :class:`~dllama_tpu.kv.pool.PagePool`).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
+
+if TYPE_CHECKING:
+    from .pool import PagePool
 
 
 @dataclass
@@ -35,7 +38,7 @@ class MatchResult:
 class _Node:
     __slots__ = ("tokens", "start", "children", "pages", "parent", "last_access")
 
-    def __init__(self, tokens: Tuple[int, ...], start: int, parent: Optional["_Node"]):
+    def __init__(self, tokens: Tuple[int, ...], start: int, parent: Optional["_Node"]) -> None:
         self.tokens = tokens          # edge label from parent
         self.start = start            # absolute position of tokens[0]
         self.children: Dict[int, _Node] = {}
@@ -49,7 +52,7 @@ class _Node:
 
 
 class RadixTree:
-    def __init__(self, page_size: int):
+    def __init__(self, page_size: int) -> None:
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         self.page_size = page_size
@@ -219,7 +222,7 @@ class RadixTree:
             node = child
 
     # -- eviction ----------------------------------------------------------
-    def evict(self, n_pages: int, pool) -> int:
+    def evict(self, n_pages: int, pool: "PagePool") -> int:
         """LRU-evict leaves whose pages only the tree holds (refcount 1),
         releasing them into ``pool`` until ``n_pages`` are freed or nothing
         is evictable.  Returns pages freed."""
@@ -283,7 +286,7 @@ class RadixTree:
             stack.extend(node.children.values())
         return out
 
-    def clear(self, pool=None) -> None:
+    def clear(self, pool: Optional["PagePool"] = None) -> None:
         if pool is not None:
             pages = self.all_pages()
             if pages:

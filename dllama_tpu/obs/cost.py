@@ -17,7 +17,12 @@ per chip, the HBM floor in ms/token, and the implied tok/s ceiling.
 
 from __future__ import annotations
 
+from typing import TYPE_CHECKING
+
 import jax
+
+if TYPE_CHECKING:
+    from ..formats.model_file import LlmHeader
 
 # Approximate per-chip HBM peak bandwidth by TPU generation, bytes/s
 # (public chip specs; matched against jax.devices()[0].device_kind,
@@ -50,7 +55,7 @@ def hbm_peak_bytes_per_s() -> float | None:
     return None
 
 
-def extract_cost(compiled) -> dict | None:
+def extract_cost(compiled: object) -> dict | None:
     """{flops, bytes_accessed} from an executable's ``cost_analysis()``,
     or None when the object is not an AOT-compiled executable (lazily
     jitted step fns), the backend returns nothing, or the surface raises.
@@ -92,7 +97,9 @@ def roofline_fraction(
     return (bytes_accessed / step_seconds) / peak_bytes_per_s
 
 
-def weight_bytes_per_token(h, weight_format: str, i8_group: int = 512) -> int:
+def weight_bytes_per_token(
+    h: "LlmHeader", weight_format: str, i8_group: int = 512
+) -> int:
     """HBM bytes of weights a single decode step must read: every matmul
     weight once (MoE: attention weights + the active experts' share).
     Q40 device layout = int8 values + f32 scale per 32 block = 1.125
@@ -115,7 +122,8 @@ def weight_bytes_per_token(h, weight_format: str, i8_group: int = 512) -> int:
 
 
 def roofline_report(
-    h, weight_format: str, tp: int = 1, pp: int = 1, i8_group: int = 512
+    h: "LlmHeader", weight_format: str, tp: int = 1, pp: int = 1,
+    i8_group: int = 512
 ) -> dict:
     """Analytic decode roofline for this model/format/layout: weight-read
     bytes per token per chip (weights shard over tp x pp; dp/sp replicate
@@ -137,7 +145,8 @@ def roofline_report(
 
 
 def print_roofline_report(
-    h, weight_format: str, tp: int = 1, pp: int = 1, i8_group: int = 512
+    h: "LlmHeader", weight_format: str, tp: int = 1, pp: int = 1,
+    i8_group: int = 512
 ) -> dict:
     """Startup roofline printout (rides next to the memory/ICI reports in
     cli.load_engine); returns the report dict it printed."""

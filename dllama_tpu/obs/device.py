@@ -53,7 +53,7 @@ def device_memory_stats() -> list[dict]:
     return out
 
 
-def sample_device_memory(registry=None) -> list[dict]:
+def sample_device_memory(registry: object | None = None) -> list[dict]:
     """Snapshot ``device_memory_stats()`` into registry gauges
     (``dllama_device_bytes_in_use`` / ``_peak_bytes_in_use`` /
     ``_bytes_limit``, labeled by device) and return the snapshot. On a

@@ -23,6 +23,7 @@ from __future__ import annotations
 import os
 import threading
 from bisect import bisect_left
+from typing import Sequence
 
 # serving latencies (TTFT, queue wait, prefill, dispatch): 1 ms .. 60 s
 DEFAULT_LATENCY_BUCKETS_S = (
@@ -53,7 +54,7 @@ def _fmt(v: float) -> str:
 class _Counter:
     __slots__ = ("_family", "_value")
 
-    def __init__(self, family: "_Family"):
+    def __init__(self, family: "_Family") -> None:
         self._family = family
         self._value = 0.0
 
@@ -72,7 +73,7 @@ class _Counter:
 class _Gauge:
     __slots__ = ("_family", "_value")
 
-    def __init__(self, family: "_Family"):
+    def __init__(self, family: "_Family") -> None:
         self._family = family
         self._value = 0.0
 
@@ -101,7 +102,7 @@ class _Gauge:
 class _Histogram:
     __slots__ = ("_family", "_counts", "_sum", "_count")
 
-    def __init__(self, family: "_Family"):
+    def __init__(self, family: "_Family") -> None:
         self._family = family
         # one slot per bucket + the +Inf overflow slot
         self._counts = [0] * (len(family.buckets) + 1)
@@ -218,7 +219,7 @@ class _Family:
     def count(self):
         return self._default().count
 
-    def percentile(self, q: float):
+    def percentile(self, q: float) -> float | None:
         return self._default().percentile(q)
 
     def child_values(self) -> dict[tuple[str, ...], float]:
@@ -271,7 +272,7 @@ class MetricsRegistry:
 
     CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
-    def __init__(self, enabled: bool = True):
+    def __init__(self, enabled: bool = True) -> None:
         self.enabled = enabled
         self._lock = threading.RLock()
         self._families: dict[str, _Family] = {}
@@ -282,7 +283,14 @@ class MetricsRegistry:
     def disable(self) -> None:
         self.enabled = False
 
-    def _get(self, name, help_, mtype, labelnames, buckets=None) -> _Family:
+    def _get(
+        self,
+        name: str,
+        help_: str,
+        mtype: str,
+        labelnames: Sequence[str],
+        buckets: Sequence[float] | None = None,
+    ) -> _Family:
         with self._lock:
             fam = self._families.get(name)
             if fam is not None:
@@ -296,18 +304,22 @@ class MetricsRegistry:
             self._families[name] = fam
             return fam
 
-    def counter(self, name: str, help: str = "", labelnames=()) -> _Family:
+    def counter(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> _Family:
         return self._get(name, help, "counter", labelnames)
 
-    def gauge(self, name: str, help: str = "", labelnames=()) -> _Family:
+    def gauge(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> _Family:
         return self._get(name, help, "gauge", labelnames)
 
     def histogram(
         self,
         name: str,
         help: str = "",
-        labelnames=(),
-        buckets=DEFAULT_LATENCY_BUCKETS_S,
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_S,
     ) -> _Family:
         return self._get(name, help, "histogram", labelnames, buckets)
 

@@ -60,7 +60,7 @@ class FlightRecorder:
         capacity: int = DEFAULT_CAPACITY,
         enabled: bool = True,
         postmortem_dir: str | None = None,
-    ):
+    ) -> None:
         self.capacity = capacity
         self.enabled = enabled
         self.postmortem_dir = postmortem_dir
@@ -97,7 +97,8 @@ class FlightRecorder:
 
     @property
     def total_recorded(self) -> int:
-        return self._seq
+        with self._lock:
+            return self._seq
 
     def clear(self) -> None:
         with self._lock:

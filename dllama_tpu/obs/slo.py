@@ -35,6 +35,7 @@ import threading
 import time
 
 from collections import deque
+from typing import Callable
 
 from .metrics import get_registry
 
@@ -68,11 +69,11 @@ class SloTracker:
         self,
         ttft_target_ms: float | None = None,
         tpot_target_ms: float | None = None,
-        registry=None,
-        clock=time.monotonic,
+        registry: object | None = None,
+        clock: Callable[[], float] = time.monotonic,
         max_requests: int = 4096,
         max_token_events: int = 16384,
-    ):
+    ) -> None:
         self.ttft_target_ms = ttft_target_ms
         self.tpot_target_ms = tpot_target_ms
         self._clock = clock
@@ -143,7 +144,7 @@ class SloTracker:
             )
         return met
 
-    def observe_span(self, span) -> bool | None:
+    def observe_span(self, span: object) -> bool | None:
         """Record a finished :class:`~dllama_tpu.obs.trace.RequestSpan`.
         Only clean finishes (stop/length) count toward attainment —
         a cancelled stream says nothing about the service's latency."""

@@ -41,6 +41,7 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
+from typing import Callable, Iterator
 
 from .recorder import get_recorder
 
@@ -73,9 +74,10 @@ class SpanTracker:
         self,
         capacity: int = DEFAULT_CAPACITY,
         enabled: bool | None = None,
-        clock=time.perf_counter,
-        recorder=None,
-    ):
+        clock: Callable[[], float] = time.perf_counter,
+        wall_clock: Callable[[], float] = time.time,
+        recorder: object | None = None,
+    ) -> None:
         self.enabled = (
             enabled
             if enabled is not None
@@ -84,7 +86,7 @@ class SpanTracker:
         self.capacity = capacity
         self._clock = clock
         self._epoch = clock()  # all span t0s are seconds since this anchor
-        self.epoch_unix = time.time()
+        self.epoch_unix = wall_clock()
         self._ring: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._recorder = recorder
@@ -149,16 +151,17 @@ class SpanTracker:
                 # every span once the ring is full)
                 overflowed = self._dropped % self.capacity == 1
             self._ring.append(rec)
+            dropped = self._dropped
         if overflowed:
             self.recorder.record(
                 "obs_overflow", what="span_ring", capacity=self.capacity,
-                dropped=self._dropped,
+                dropped=dropped,
             )
 
     @contextmanager
     def span(self, name: str, component: str = "engine",
              request_id: str | None = None, lane: int | None = None,
-             **attrs):
+             **attrs) -> Iterator[_SpanHandle]:
         """``with tracker.span("admission_chunk", ...):`` — the body is
         timed even when it raises (the error still took the time)."""
         handle = self.begin(name, component, request_id, lane, **attrs)
@@ -178,11 +181,13 @@ class SpanTracker:
 
     @property
     def total_recorded(self) -> int:
-        return self._total
+        with self._lock:
+            return self._total
 
     @property
     def dropped(self) -> int:
-        return self._dropped
+        with self._lock:
+            return self._dropped
 
     def clear(self) -> None:
         with self._lock:
@@ -242,7 +247,7 @@ class SpanTracker:
             "dllama": {
                 "epoch_unix": self.epoch_unix,
                 "n_spans": len(spans),
-                "dropped": self._dropped,
+                "dropped": self.dropped,
             },
         }
         if request_id is not None:

@@ -39,7 +39,7 @@ class RequestSpan:
     are seconds on the monotonic clock, ``submitted_unix`` is wall time."""
 
     def __init__(self, tracer: "Tracer | None", request_id: str | None = None,
-                 path: str = "lanes"):
+                 path: str = "lanes") -> None:
         self.tracer = tracer
         self.request_id = request_id or f"req-{uuid.uuid4().hex[:12]}"
         self.path = path
@@ -139,7 +139,8 @@ class _NullSpan(RequestSpan):
         super().__init__(tracer=None, request_id="null", path="null")
         self._finished = True  # finish() no-ops forever
 
-    def mark_admitted(self, lane=None, reused_prefix_tokens=0) -> float:
+    def mark_admitted(self, lane: int | None = None,
+                      reused_prefix_tokens: int = 0) -> float:
         return 0.0
 
     def mark_first_token(self):
@@ -153,7 +154,7 @@ class Tracer:
     """Bounded ring buffer of finished-request records + optional JSONL
     sink; thread-safe. See module docstring."""
 
-    def __init__(self, capacity: int = 512, sink_path: str | None = None):
+    def __init__(self, capacity: int = 512, sink_path: str | None = None) -> None:
         self._ring: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self.sink_path = sink_path

@@ -47,7 +47,9 @@ from __future__ import annotations
 import os
 import threading
 import time
+from typing import Callable
 
+from ..analysis.lockwatch import make_lock
 from .metrics import get_registry
 from .recorder import get_recorder
 
@@ -77,11 +79,11 @@ class EngineWatchdog:
         dispatch_timeout_s: float = 30.0,
         stall_factor: float = 20.0,
         min_stall_s: float = 5.0,
-        block_p99=None,
-        clock=time.monotonic,
-        registry=None,
-        recorder=None,
-    ):
+        block_p99: Callable[[], float | None] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        registry: object | None = None,
+        recorder: object | None = None,
+    ) -> None:
         self.interval_s = interval_s
         self.dispatch_timeout_s = dispatch_timeout_s
         self.stall_factor = stall_factor
@@ -108,7 +110,7 @@ class EngineWatchdog:
             "Seconds since the scheduler loop last beat the watchdog "
             "(refreshed on every watchdog check).",
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.watchdog")
         # liveness signals (mutated by the scheduler thread)
         self._last_beat: float | None = None
         self._n_active = 0
@@ -228,11 +230,11 @@ class EngineWatchdog:
             now = self._clock()
         hit = self._evaluate(now)
         if hit is None:
-            if self.stalled_reason is not None:
-                with self._lock:
-                    reason, self.stalled_reason = self.stalled_reason, None
-                    self.stalled_detail = None
-                    self._stalled_since = None
+            with self._lock:
+                reason, self.stalled_reason = self.stalled_reason, None
+                self.stalled_detail = None
+                self._stalled_since = None
+            if reason is not None:
                 self.g_degraded.set(0)
                 self.recorder.record("watchdog_recovered", reason=reason)
             return None
@@ -258,7 +260,8 @@ class EngineWatchdog:
 
     @property
     def degraded(self) -> bool:
-        return self.stalled_reason is not None
+        with self._lock:
+            return self.stalled_reason is not None
 
     def status(self) -> dict:
         with self._lock:

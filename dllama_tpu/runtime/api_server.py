@@ -45,6 +45,7 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
+from ..analysis.lockwatch import make_condition, make_lock
 from ..obs.device import compare_with_analytic, sample_device_memory
 from ..obs.metrics import DEFAULT_TOKEN_BUCKETS_S, get_registry
 from ..obs.recorder import get_recorder
@@ -298,13 +299,25 @@ class LaneScheduler:
         self._clock = time.perf_counter
         self._last_decode_end: float | None = None
         self.pending: list[LaneJob] = []
-        self.cv = threading.Condition()
+        self.cv = make_condition("sched.cv")
+        self._stop = False
         # build the admission-path programs (every prefill bucket + the
         # decode block) off-thread NOW, so the first admission under load
         # doesn't pay a synchronous compile stall
         self.engine.rehearse_admission(self.block_size)
-        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True, name="dllama-scheduler"
+        )
         self.thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop the scheduler thread (idempotent; used by server close and
+        by tests that churn many servers in one process)."""
+        with self.cv:
+            self._stop = True
+            self.cv.notify_all()
+        if self.thread.is_alive():
+            self.thread.join(timeout=timeout)
 
     def submit(self, params: InferenceParams) -> LaneJob:
         job = LaneJob(params)
@@ -332,11 +345,14 @@ class LaneScheduler:
         while True:
             with self.cv:
                 while (
-                    not self.pending
+                    not self._stop
+                    and not self.pending
                     and not any(self.lanes)
                     and not self.admitting
                 ):
                     self.cv.wait()
+                if self._stop:
+                    return
                 admissions = []
                 free = [
                     i
@@ -353,7 +369,8 @@ class LaneScheduler:
                     self._admission_count += 1
                     self.lane_used[lane] = self._admission_count
                     admissions.append((lane, job))
-                self.state.m_queue_depth.set(len(self.pending))
+                n_pending = len(self.pending)
+                self.state.m_queue_depth.set(n_pending)
             # liveness heartbeat: the watchdog's scheduler-stalled rule
             # audits the gap between these
             wd = self.state.watchdog
@@ -364,7 +381,7 @@ class LaneScheduler:
                 )
             tick_sp = self.state.spans.begin(
                 "sched_tick", component="scheduler",
-                n_pending=len(self.pending), n_admitting=len(self.admitting),
+                n_pending=n_pending, n_admitting=len(self.admitting),
             )
             for lane, job in admissions:
                 self._begin_admission(lane, job)
@@ -923,7 +940,7 @@ class ApiState:
             chat_template_type, tokenizer.chat_template, eos_piece
         )
         self.naive_cache = NaiveCache()
-        self.lock = threading.Lock()
+        self.lock = make_lock("api.state")
         # batch_size > 1 engines serve requests CONCURRENTLY over the
         # engine's batch lanes (the reference's accept loop — and the
         # batch_size == 1 path here — serves one request at a time)
@@ -1304,8 +1321,11 @@ def make_handler(state: ApiState):
                 sched = state.scheduler
                 total = state.engine.batch_size if sched is not None else 1
                 if sched is not None:
-                    active = sum(1 for ls in sched.lanes if ls is not None)
-                    queued = len(sched.pending)
+                    with sched.cv:
+                        active = sum(
+                            1 for ls in sched.lanes if ls is not None
+                        )
+                        queued = len(sched.pending)
                 else:
                     active = 1 if state.lock.locked() else 0
                     queued = 0
@@ -1606,14 +1626,18 @@ def serve(
         state.spans.set_sink(timeline_out)
     server = ThreadingHTTPServer((host, port), make_handler(state))
     server.state = state  # tests and callers reach the tracer/registry here
-    if timeline_out:
-        inner_close = server.server_close
+    inner_close = server.server_close
 
-        def _close_and_flush():
-            inner_close()
+    def _close_and_flush():
+        inner_close()
+        if state.scheduler is not None:
+            state.scheduler.stop()
+        if state.watchdog is not None:
+            state.watchdog.stop()
+        if timeline_out:
             state.spans.flush()
 
-        server.server_close = _close_and_flush
+    server.server_close = _close_and_flush
     if host in ("0.0.0.0", "127.0.0.1"):
         print(f"Server URL: http://localhost:{port}/v1/")
     return server  # caller runs serve_forever() (tests drive it in a thread)

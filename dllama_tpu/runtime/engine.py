@@ -404,10 +404,12 @@ class InferenceEngine:
         import os as _os
         import threading as _threading
 
+        from ..analysis.lockwatch import make_lock
+
         self._aot_blocks = (
             _os.environ.get("DLLAMA_WINDOW_PRECOMPILE", "1") != "0"
         )
-        self._compile_lock = _threading.Lock()
+        self._compile_lock = make_lock("engine.compile")
         self._inflight: dict = {}  # key -> threading.Event
         self._compile_origin: dict = {}
         self._compile_seconds: dict = {}  # key -> AOT build wall seconds
@@ -574,8 +576,9 @@ class InferenceEngine:
     def _step_fn(self, t: int, greedy: bool, window: int = 0):
         """Build/jit the forward step for chunk length `t`."""
         key = (t, greedy, window)
-        if key in self._compiled:
-            return self._compiled[key]
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
         precision = self._precision
         fwd = self._fwd
 
@@ -600,8 +603,9 @@ class InferenceEngine:
                 return jnp.argmax(last, axis=-1).astype(jnp.int32), cache
             return last, cache
 
-        self._compiled[key] = step
-        self._compile_origin[key] = "dispatch"
+        with self._compile_lock:
+            self._compiled[key] = step
+            self._compile_origin[key] = "dispatch"
         self._m_compiles.labels(origin="dispatch").inc()
         # lazily jitted: XLA compiles on first call, so there is no build
         # time to record here — one deferred marker instead of start/end
@@ -751,7 +755,11 @@ class InferenceEngine:
                     self._inflight.pop(key, None)
                 ev.set()
 
-        threading.Thread(target=work, daemon=True).start()
+        # joined via the per-key `ev` Event in _decode_block_fn (the
+        # dispatch path waits on it), not via the Thread handle
+        threading.Thread(  # dlint: disable=thread-hygiene — lifetime bounded by the _inflight[key] Event; waiters join through ev.wait()
+            target=work, daemon=True, name=f"dllama-prefetch-{key[1]}"
+        ).start()
 
     def _prefetch_block(self, n_steps: int, greedy: bool, window: int) -> None:
         self._prefetch(
@@ -845,8 +853,9 @@ class InferenceEngine:
         ONE scalar (no [T, vocab] logits transfer — the reference ships the
         full logits pipe to host per batch, src/dllama.cpp:132-172)."""
         key = ("score", t, window)
-        if key in self._compiled:
-            return self._compiled[key]
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
         precision = self._precision
         fwd = self._fwd
 
@@ -868,8 +877,9 @@ class InferenceEngine:
             nll = (lse - tgt) * mask
             return jnp.sum(nll[0]), cache
 
-        self._compiled[key] = score
-        self._compile_origin[key] = "dispatch"
+        with self._compile_lock:
+            self._compiled[key] = score
+            self._compile_origin[key] = "dispatch"
         self._m_compiles.labels(origin="dispatch").inc()
         self.recorder.record(
             "compile", key=str(key), origin="dispatch", deferred=True

@@ -1,24 +1,10 @@
 #!/usr/bin/env python
 """Lint: every registered `dllama_*` metric is documented, and vice versa.
 
-The metric tables in docs/serving_metrics.md are the operator contract —
-dashboards and alerts are built off them. This check fails CI when a
-metric is registered in code but missing from the doc (silent new
-telemetry nobody can discover) or documented but no longer registered
-(dashboards querying a phantom).
-
-Source side: static scan of `reg.counter("dllama_...")` /
-`.gauge(` / `.histogram(` registration calls across `dllama_tpu/` and
-`bench.py` (registrations span lines, so the regex runs over whole file
-contents). Dynamically named metrics — `utils/telemetry.Counter`'s
-f-string `dllama_<name>_events_total` pair — have no literal name at the
-registration site and are intentionally out of scope; the doc describes
-them as a template.
-
-Doc side: every backticked `dllama_*` identifier in
-docs/serving_metrics.md. The `<name>` placeholder in the Counter
-template breaks the identifier pattern, so the template never counts as
-a concrete metric.
+This check is now the `metrics-docs` rule inside the dlint framework
+(`python -m dllama_tpu.analysis` runs it with everything else); this
+script survives as a thin shim so existing invocations and CI steps keep
+working. See dllama_tpu/analysis/rules_metrics.py for the semantics.
 
 Usage: python scripts/check_metrics_docs.py  (exit 0 clean, 1 drifted)
 """
@@ -26,51 +12,27 @@ Usage: python scripts/check_metrics_docs.py  (exit 0 clean, 1 drifted)
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
-DOC = REPO / "docs" / "serving_metrics.md"
+sys.path.insert(0, str(REPO))
 
-_REGISTRATION = re.compile(
-    r"\b(?:counter|gauge|histogram)\(\s*[\"'](dllama_[a-z0-9_]+)[\"']"
-)
-_DOC_NAME = re.compile(r"`(dllama_[a-z0-9_]+)`")
-
-
-def registered_names() -> set[str]:
-    names: set[str] = set()
-    sources = list((REPO / "dllama_tpu").rglob("*.py"))
-    sources.append(REPO / "bench.py")
-    for path in sources:
-        names |= set(_REGISTRATION.findall(path.read_text()))
-    return names
-
-
-def documented_names() -> set[str]:
-    return set(_DOC_NAME.findall(DOC.read_text()))
+from dllama_tpu.analysis.core import collect_repo, run_rules  # noqa: E402
+from dllama_tpu.analysis.rules_metrics import MetricsDocsRule  # noqa: E402
 
 
 def main() -> int:
-    code = registered_names()
-    doc = documented_names()
-    undocumented = sorted(code - doc)
-    phantom = sorted(doc - code)
-    if undocumented:
-        print(f"metrics registered in code but missing from {DOC.name}:")
-        for n in undocumented:
-            print(f"  {n}")
-    if phantom:
-        print(f"metrics documented in {DOC.name} but registered nowhere:")
-        for n in phantom:
-            print(f"  {n}")
-    if undocumented or phantom:
+    repo = collect_repo(REPO, ["dllama_tpu", "bench.py"])
+    findings, _ = run_rules(repo, [MetricsDocsRule()])
+    for f in findings:
+        print(f.render())
+    if findings:
         print(
             "\nfix: update the tables in docs/serving_metrics.md to match "
             "the registration sites (grep for the name above)."
         )
         return 1
-    print(f"metrics docs in sync: {len(code)} metrics, all documented")
+    print("metrics docs in sync (dlint metrics-docs rule)")
     return 0
 
 

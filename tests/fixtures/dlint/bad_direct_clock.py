@@ -1,0 +1,12 @@
+"""dlint fixture: direct-clock MUST fire here (module takes clock= but a
+code path reads the real clock anyway)."""
+import time
+
+
+class Window:
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._t0 = clock()
+
+    def elapsed(self):
+        return time.monotonic() - self._t0  # BAD: bypasses the injected clock

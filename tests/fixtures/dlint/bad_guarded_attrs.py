@@ -1,0 +1,21 @@
+"""dlint fixture: guarded-attrs MUST fire here (unlocked read/write of a
+lock-guarded attribute). Never imported; parsed by tests/test_analysis.py."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._log = []
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+            self._log.append(self._n)
+
+    def peek(self):
+        return self._n  # BAD: guarded read without the lock
+
+    def clobber(self):
+        self._n = 0  # BAD: guarded write without the lock

@@ -1,0 +1,16 @@
+"""dlint fixture: retain-release MUST fire here (leaked alloc on an early
+return, and a retain exposed to a risky device call with no protection)."""
+
+
+class Manager:
+    def leaky_match(self, tokens):
+        pages = self.pool.alloc(2)
+        if not tokens:
+            return 0  # BAD: `pages` leaks on this path
+        self.pool.release(pages)
+        return len(pages)
+
+    def unprotected_publish(self, lane, pages):
+        self.pool.retain(pages)
+        self.engine.kv_publish(lane, pages)  # BAD: raise here leaks the retain
+        self.pool.release(pages)

@@ -1,0 +1,18 @@
+"""dlint fixture: thread-hygiene MUST fire here (anonymous, non-daemon,
+fire-and-forget, and a stored thread with no stop path)."""
+import threading
+
+
+def fire_and_forget(work):
+    threading.Thread(target=work).start()  # BAD: all three violations
+
+
+class Looper:
+    def __init__(self):
+        # BAD: stored but Looper has no stop/close/shutdown/join method
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name="dllama-loop"
+        )
+
+    def _run(self):
+        pass

@@ -1,0 +1,19 @@
+"""dlint fixture: trace-purity MUST fire here (host side effects inside a
+jitted function, including one reached transitively)."""
+import time
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(x):
+    t0 = time.monotonic()  # BAD: clock read burns into the trace
+    print("tracing", t0)   # BAD: prints once at trace time
+    return helper(x)
+
+
+def helper(x):
+    # transitively traced via step(); still impure
+    t = time.perf_counter()  # BAD
+    return x * t

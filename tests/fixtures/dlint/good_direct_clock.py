@@ -1,0 +1,13 @@
+"""dlint fixture: direct-clock must stay quiet — the bare reference as a
+default is the injection point, and all reads go through it."""
+import time
+
+
+class Window:
+    def __init__(self, clock=time.monotonic, wall_clock=time.time):
+        self._clock = clock
+        self._t0 = clock()
+        self.epoch_unix = wall_clock()
+
+    def elapsed(self):
+        return self._clock() - self._t0

@@ -1,0 +1,24 @@
+"""dlint fixture: guarded-attrs must stay quiet here (every access locked,
+plus the sanctioned conventions: __init__, *_locked helpers, suppression)."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        with self._lock:
+            return self._n
+
+    def _drain_locked(self):
+        # caller holds self._lock (project suffix convention)
+        return self._n
+
+    def peek_racy(self):
+        return self._n  # dlint: disable=guarded-attrs — monitoring read; a stale value is fine

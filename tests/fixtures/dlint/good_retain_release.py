@@ -1,0 +1,27 @@
+"""dlint fixture: retain-release must stay quiet here — every exit path
+releases, transfers ownership, or returns the pages to the caller."""
+
+
+class Manager:
+    def balanced_match(self, lane, tokens):
+        pages = self.pool.alloc(2)
+        if not tokens:
+            self.pool.release(pages)
+            return 0
+        self._lane_pages[lane] = pages  # ownership transfer: lane map owns it
+        return len(pages)
+
+    def protected_publish(self, lane, pages):
+        self.pool.retain(pages)
+        try:
+            self.engine.kv_publish(lane, pages)  # protected by finally
+        finally:
+            self.pool.release(pages)
+
+    def handed_to_caller(self, n):
+        pages = self.pool.alloc(n)
+        return pages  # caller owns the refcount now
+
+    def stored_in_tree(self, tokens, n):
+        pages = self.pool.alloc(n)
+        self.tree.insert(tokens, pages, 0)  # tree owns it now

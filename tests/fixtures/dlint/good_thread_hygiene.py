@@ -1,0 +1,29 @@
+"""dlint fixture: thread-hygiene must stay quiet — named + daemonized +
+joined (directly, via list iteration, or via a class stop path)."""
+import threading
+
+
+def run_workers(work, n):
+    threads = [
+        threading.Thread(
+            target=work, daemon=True, name=f"dllama-worker-{i}"
+        )
+        for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+class Looper:
+    def __init__(self):
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name="dllama-loop"
+        )
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self.thread.join(timeout=1.0)

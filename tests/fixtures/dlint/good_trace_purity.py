@@ -1,0 +1,18 @@
+"""dlint fixture: trace-purity must stay quiet — effects happen outside
+the traced function; in-trace debugging uses the sanctioned tools."""
+import time
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(x):
+    jax.debug.print("x = {}", x)  # sanctioned in-trace output
+    return x * 2
+
+
+def dispatch(x):
+    t0 = time.monotonic()  # fine: host code, not traced
+    y = step(x)
+    return y, time.monotonic() - t0

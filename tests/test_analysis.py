@@ -1,0 +1,164 @@
+"""dlint self-tests (PR 8): each rule fires on its bad fixture and stays
+quiet on its good one; suppressions and the baseline behave; and the
+repo itself lints clean (the same gate CI's fast lane runs).
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from dllama_tpu.analysis import all_rules
+from dllama_tpu.analysis.core import (
+    Finding,
+    apply_baseline,
+    collect_repo,
+    load_baseline,
+    run_rules,
+    write_baseline,
+)
+from dllama_tpu.analysis.rules_clock import DirectClockRule
+from dllama_tpu.analysis.rules_kv import RetainReleaseRule
+from dllama_tpu.analysis.rules_locks import GuardedAttrsRule
+from dllama_tpu.analysis.rules_metrics import MetricsDocsRule
+from dllama_tpu.analysis.rules_threads import ThreadHygieneRule
+from dllama_tpu.analysis.rules_trace import TracePurityRule
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+FIXDIR = "tests/fixtures/dlint"
+
+
+def lint(fixture: str, rule):
+    repo = collect_repo(REPO_ROOT, [f"{FIXDIR}/{fixture}"])
+    assert not repo.parse_errors, repo.parse_errors
+    findings, n_suppressed = run_rules(repo, [rule])
+    return findings, n_suppressed
+
+
+CASES = [
+    (GuardedAttrsRule(), "guarded_attrs"),
+    (RetainReleaseRule(), "retain_release"),
+    (DirectClockRule(), "direct_clock"),
+    (TracePurityRule(), "trace_purity"),
+    (ThreadHygieneRule(), "thread_hygiene"),
+]
+
+
+@pytest.mark.fast
+@pytest.mark.parametrize(
+    "rule,stem", CASES, ids=[r.name for r, _ in CASES]
+)
+def test_rule_fires_on_bad_fixture(rule, stem):
+    findings, _ = lint(f"bad_{stem}.py", rule)
+    assert findings, f"{rule.name} found nothing in bad_{stem}.py"
+    assert all(f.rule == rule.name for f in findings)
+
+
+@pytest.mark.fast
+@pytest.mark.parametrize(
+    "rule,stem", CASES, ids=[r.name for r, _ in CASES]
+)
+def test_rule_quiet_on_good_fixture(rule, stem):
+    findings, _ = lint(f"good_{stem}.py", rule)
+    assert findings == [], [f.render() for f in findings]
+
+
+@pytest.mark.fast
+def test_specific_bad_findings_anchor_where_expected():
+    findings, _ = lint("bad_guarded_attrs.py", GuardedAttrsRule())
+    msgs = [f.message for f in findings]
+    assert any("read without a lock in peek()" in m for m in msgs)
+    assert any("written without a lock in clobber()" in m for m in msgs)
+
+    findings, _ = lint("bad_retain_release.py", RetainReleaseRule())
+    msgs = [f.message for f in findings]
+    assert any("not released before the return" in m for m in msgs)
+    assert any("kv_publish" in m and "leak" in m for m in msgs)
+
+    findings, _ = lint("bad_trace_purity.py", TracePurityRule())
+    msgs = " ".join(f.message for f in findings)
+    assert "time.monotonic()" in msgs
+    assert "print()" in msgs
+    assert "helper()" in msgs  # reached transitively
+
+
+@pytest.mark.fast
+def test_inline_suppression_counts_and_silences():
+    # good_guarded_attrs.py carries one justified `# dlint: disable=`
+    findings, n_suppressed = lint("good_guarded_attrs.py", GuardedAttrsRule())
+    assert findings == []
+    assert n_suppressed == 1
+
+
+@pytest.mark.fast
+def test_metrics_docs_rule_both_directions(tmp_path):
+    (tmp_path / "dllama_tpu").mkdir()
+    (tmp_path / "dllama_tpu" / "m.py").write_text(
+        'c = counter("dllama_documented_total", "d")\n'
+        'g = gauge("dllama_undocumented_thing", "d")\n'
+    )
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "serving_metrics.md").write_text(
+        "`dllama_documented_total` — fine\n"
+        "`dllama_phantom_metric` — registered nowhere\n"
+    )
+    repo = collect_repo(tmp_path, ["dllama_tpu"])
+    findings, _ = run_rules(repo, [MetricsDocsRule()])
+    msgs = " ".join(f.message for f in findings)
+    assert "dllama_undocumented_thing" in msgs
+    assert "dllama_phantom_metric" in msgs
+    assert "dllama_documented_total" not in msgs
+
+
+@pytest.mark.fast
+def test_baseline_roundtrip_and_staleness(tmp_path):
+    f1 = Finding(rule="r", path="a.py", line=3, message="m1")
+    f2 = Finding(rule="r", path="a.py", line=9, message="m2")
+    bp = tmp_path / "baseline.json"
+    write_baseline(bp, [f1])
+    baseline = load_baseline(bp)
+    # f1 baselined, f2 new; a fingerprint with no live finding is stale
+    new, old, stale = apply_baseline([f1, f2], baseline | {"r::gone.py::x"})
+    assert [f.message for f in new] == ["m2"]
+    assert [f.message for f in old] == ["m1"]
+    assert stale == {"r::gone.py::x"}
+    # fingerprints survive line drift (no line numbers inside)
+    drifted = Finding(rule="r", path="a.py", line=33, message="m1")
+    assert drifted.fingerprint() == f1.fingerprint()
+    assert json.loads(bp.read_text())["findings"] == [f1.fingerprint()]
+
+
+@pytest.mark.fast
+def test_repo_lints_clean():
+    """The acceptance gate: `python -m dllama_tpu.analysis` exits 0."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dllama_tpu.analysis"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.fast
+def test_cli_rule_selection_and_exit_codes():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dllama_tpu.analysis", "--list-rules"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    for r in all_rules():
+        assert r.name in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "dllama_tpu.analysis", "--rules", "nope"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2
+
+    bad = f"{FIXDIR}/bad_guarded_attrs.py"
+    proc = subprocess.run(
+        [sys.executable, "-m", "dllama_tpu.analysis", "--no-baseline", bad],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "guarded-attrs" in proc.stdout

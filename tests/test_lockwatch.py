@@ -1,0 +1,259 @@
+"""lockwatch tests (PR 8): lock-order cycle detection, deterministic
+seeded interleaving, the env-gated production factory, and the PR 6
+match->adopt race replayed as a regression against the real PagePool +
+RadixTree.
+
+The PR 6 bug shape: ``match()`` returned radix-tree pages WITHOUT
+retaining them; the scheduler ran the adopt copy one tick later. In
+that window another lane's publish->evict could free the refcount-1
+pages and the pool could hand them to a different request — the late
+retain then pinned pages that now hold someone else's KV (silent
+cross-request corruption). The fix retains inside ``match()`` under the
+manager lock. Here both protocols run under the Interleaver across a
+seed sweep: the pre-fix shape corrupts on at least one schedule and
+does so identically on replay; the post-fix shape is clean on every
+schedule.
+"""
+
+import threading
+
+import pytest
+
+from dllama_tpu.analysis import lockwatch
+from dllama_tpu.analysis.lockwatch import (
+    Interleaver,
+    LockOrderViolation,
+    LockWatch,
+    TrackedLock,
+    make_condition,
+    make_lock,
+)
+from dllama_tpu.kv import PagePool, RadixTree
+
+PS = 4
+
+
+# -- lock-order graph ---------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_cycle_detected_across_threads():
+    """A->B in one thread, B->A in another: the second order must raise
+    (this schedule is the textbook deadlock shape)."""
+    w = LockWatch()
+    a, b = TrackedLock("A", w), TrackedLock("B", w)
+    itl = Interleaver(seed=3)
+
+    def forward():
+        with itl.acquire(a, "A"):
+            itl.step("holding-A")
+            with itl.acquire(b, "B"):
+                itl.step("holding-AB")
+
+    def backward():
+        with itl.acquire(b, "B"):
+            itl.step("holding-B")
+            with itl.acquire(a, "A"):
+                itl.step("holding-BA")
+
+    itl.spawn("fwd", forward)
+    itl.spawn("bwd", backward)
+    with pytest.raises(LockOrderViolation) as ei:
+        itl.run()
+    msg = str(ei.value)
+    assert "closes the cycle" in msg and "A" in msg and "B" in msg
+
+
+@pytest.mark.fast
+def test_consistent_order_is_clean():
+    w = LockWatch()
+    a, b = TrackedLock("A", w), TrackedLock("B", w)
+    for _ in range(3):
+        with a, b:
+            pass
+    assert w.edges() == {"A": {"B"}}
+
+
+@pytest.mark.fast
+def test_three_lock_cycle_detected():
+    """A->B, B->C, then C->A: the cycle spans three locks, not a simple
+    inversion, so detection must walk the graph transitively."""
+    w = LockWatch()
+    a, b, c = (TrackedLock(n, w) for n in "ABC")
+    with a, b:
+        pass
+    with b, c:
+        pass
+    with pytest.raises(LockOrderViolation):
+        with c:
+            a.acquire()
+
+
+@pytest.mark.fast
+def test_tracked_lock_is_drop_in():
+    w = LockWatch()
+    lk = TrackedLock("L", w)
+    assert not lk.locked()
+    assert lk.acquire(blocking=False)
+    assert lk.locked()
+    assert not lk.acquire(blocking=False)  # held -> non-blocking fails
+    lk.release()
+    assert not lk.locked()
+    # Condition built over a TrackedLock: wait/notify round trip works
+    cond = threading.Condition(TrackedLock("C", w))
+    hits = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5.0)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter, daemon=True, name="dllama-t-waiter")
+    t.start()
+    while True:
+        with cond:
+            cond.notify_all()
+        t.join(timeout=0.05)
+        if not t.is_alive():
+            break
+    assert hits == [1]
+
+
+@pytest.mark.fast
+def test_factory_is_env_gated(monkeypatch):
+    monkeypatch.delenv("DLLAMA_LOCKWATCH", raising=False)
+    assert isinstance(make_lock("x"), type(threading.Lock()))
+    monkeypatch.setenv("DLLAMA_LOCKWATCH", "1")
+    lk = make_lock("x")
+    assert isinstance(lk, TrackedLock)
+    cond = make_condition("y")
+    assert isinstance(cond, threading.Condition)
+    lockwatch.global_watch().reset()
+
+
+# -- deterministic interleaving ----------------------------------------------
+
+
+def _two_thread_trace(seed):
+    itl = Interleaver(seed=seed)
+    order = []
+
+    def a():
+        itl.step("a1")
+        order.append("a1")
+        itl.step("a2")
+        order.append("a2")
+
+    def b():
+        itl.step("b1")
+        order.append("b1")
+        itl.step("b2")
+        order.append("b2")
+
+    itl.spawn("a", a)
+    itl.spawn("b", b)
+    trace = itl.run()
+    return trace, order
+
+
+@pytest.mark.fast
+def test_interleaver_is_deterministic_per_seed():
+    t1, o1 = _two_thread_trace(7)
+    t2, o2 = _two_thread_trace(7)
+    assert t1 == t2 and o1 == o2
+    # and seeds actually explore different schedules
+    seen = {tuple(_two_thread_trace(s)[1]) for s in range(8)}
+    assert len(seen) > 1
+
+
+@pytest.mark.fast
+def test_interleaver_propagates_thread_errors():
+    itl = Interleaver(seed=0)
+
+    def boom():
+        itl.step("pre")
+        raise ValueError("from controlled thread")
+
+    itl.spawn("boom", boom)
+    with pytest.raises(ValueError, match="from controlled thread"):
+        itl.run()
+
+
+# -- the PR 6 match->adopt race, replayed -------------------------------------
+
+
+def _race_round(seed: int, retain_in_match: bool):
+    """One seeded schedule of victim-vs-evictor over real kv structures.
+
+    Returns (overlap, trace): pages the victim adopted that the attacker
+    was simultaneously handed (non-empty == cross-request corruption),
+    plus the schedule trace for determinism checks.
+    """
+    pool = PagePool(10, PS)
+    tree = RadixTree(PS)
+    prefix = list(range(2 * PS))
+    published = pool.alloc(2)
+    tree.insert(prefix, published, 0)  # tree holds the only refcount
+
+    itl = Interleaver(seed=seed)
+    lock = threading.Lock()  # the manager lock (plain: order not under test)
+    result = {}
+
+    def victim():
+        # manager.match(): look up the prefix under the lock
+        with itl.acquire(lock, "mgr"):
+            mr = tree.match(prefix)
+            held = list(mr.pages)
+            if retain_in_match:  # post-fix: pin pages before the gap
+                pool.retain(held)
+        itl.step("tick-gap")  # scheduler runs the adopt copy a tick later
+        with itl.acquire(lock, "mgr"):
+            if not retain_in_match:  # pre-fix: retain at adopt time
+                try:
+                    pool.retain(held)
+                except KeyError:
+                    # pages already freed AND not reallocated: loud case
+                    result["victim_pages"] = []
+                    return
+            result["victim_pages"] = held
+
+    def evictor():
+        # another lane's publish->evict pressure in the same window
+        with itl.acquire(lock, "mgr"):
+            tree.evict(2, pool)
+        itl.step("between")
+        with itl.acquire(lock, "mgr"):
+            # pool hands the freed pages straight to a new request
+            result["stolen"] = pool.alloc(min(2, pool.free_pages))
+
+    itl.spawn("victim", victim)
+    itl.spawn("evictor", evictor)
+    trace = itl.run()
+    overlap = set(result.get("victim_pages", ())) & set(
+        result.get("stolen", ())
+    )
+    return overlap, trace
+
+
+@pytest.mark.fast
+def test_pr6_race_reproduces_pre_fix_and_is_fixed_post_fix():
+    # 64 seeds: the corrupting order (match, evict, realloc, adopt) is
+    # one of ~8 equally likely schedules, so a handful of seeds hit it
+    seeds = range(64)
+    corrupting = [s for s in seeds if _race_round(s, False)[0]]
+    # the pre-fix protocol MUST corrupt under some schedule: the victim
+    # adopts pages the pool just handed to the attacker
+    assert corrupting, "no seed reproduced the pre-fix race"
+    # the post-fix protocol (retain inside match) is clean on EVERY
+    # schedule, including the ones that corrupted pre-fix
+    for s in seeds:
+        overlap, _ = _race_round(s, True)
+        assert not overlap, f"post-fix protocol corrupted under seed {s}"
+
+
+@pytest.mark.fast
+def test_pr6_race_replay_is_deterministic():
+    seed = next(s for s in range(64) if _race_round(s, False)[0])
+    o1, t1 = _race_round(seed, False)
+    o2, t2 = _race_round(seed, False)
+    assert o1 == o2 and t1 == t2  # same seed -> same schedule, same bug
