@@ -498,6 +498,29 @@ def _serving_smoke(n_clients: int) -> dict:
         "spans_by_component": dict(sorted(tl_counts.items())),
         "request_coverage": summary.get("coverage"),
     }
+
+    # in-process time-series store (ISSUE 9): force one sampler tick so
+    # short runs have data regardless of wall-clock alignment, then read
+    # the store the way the dashboard does
+    srv.state.sampler.sample_once()
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    c.request("GET", "/v1/debug/series")
+    series_idx = json.loads(c.getresponse().read().decode("utf-8"))
+    c.request(
+        "GET", "/v1/debug/series?name=dllama_lanes_active&window=600"
+    )
+    series_lanes = json.loads(c.getresponse().read().decode("utf-8"))
+    c.close()
+    series = {
+        "n_series": len(series_idx.get("names", [])),
+        "interval_s": series_idx.get("interval_s"),
+        "retention_s": series_idx.get("retention_s"),
+        "lanes_active_points": len(series_lanes.get("points", [])),
+        "anomaly_degraded": series_idx.get("anomaly", {}).get("degraded"),
+        "anomaly_active": sorted(
+            series_idx.get("anomaly", {}).get("active", {})
+        ),
+    }
     srv.shutdown()
 
     # sharing-off baseline: fresh engine + server with the pool disabled
@@ -626,6 +649,7 @@ def _serving_smoke(n_clients: int) -> dict:
         "prefix_fanout": prefix_fanout,
         "slo": slo,
         "timeline": timeline,
+        "series": series,
         "obs_overhead_pct": round(overhead_pct, 2),
     }
 

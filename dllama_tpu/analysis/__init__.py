@@ -42,6 +42,7 @@ def all_rules() -> list:
     """Every registered rule, instantiated (import-cycle-free accessor:
     rule modules import core, never the other way around)."""
     from .rules_clock import DirectClockRule
+    from .rules_dashboard import DashboardStaticRule
     from .rules_kv import RetainReleaseRule
     from .rules_locks import GuardedAttrsRule
     from .rules_metrics import MetricsDocsRule
@@ -55,4 +56,5 @@ def all_rules() -> list:
         TracePurityRule(),
         ThreadHygieneRule(),
         MetricsDocsRule(),
+        DashboardStaticRule(),
     ]

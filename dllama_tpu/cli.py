@@ -129,6 +129,13 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                    help="mean-TPOT SLO target in ms for the windowed "
                         "attainment/goodput gauges (obs/slo.py; env "
                         "DLLAMA_SLO_TPOT_MS; unset = no target)")
+    p.add_argument("--series-retention", type=float, default=None,
+                   metavar="SECONDS",
+                   help="in-process metrics time-series retention in "
+                        "seconds (obs/timeseries.py; default 3600; env "
+                        "DLLAMA_SERIES_RETENTION_S, sampling interval via "
+                        "DLLAMA_SERIES_INTERVAL_S; serves /v1/debug/series "
+                        "and the /dashboard sparklines)")
     p.add_argument("--moe-decode-dedup", default="auto", nargs="?",
                    const="on",  # bare flag keeps its r4 meaning (force on)
                    choices=["auto", "on", "off"],

@@ -24,6 +24,14 @@ trace exports + per-request millisecond accounting behind
 attainment / goodput (``slo.py``, ``dllama_slo_*`` gauges +
 ``/v1/debug/slo``), and the engine watchdog (``watchdog.py``, stall
 detection with auto-postmortem and a degraded ``/v1/health``).
+
+PR 9 makes the registry continuously *watchable* in-process: a sampler
+thread snapshots every counter/gauge/histogram-quantile into a bounded
+two-tier time-series store (``timeseries.py``, ``/v1/debug/series``),
+rolling-baseline EWMA anomaly rules over those series feed
+``/v1/health``'s degraded status (``anomaly.py``), and a zero-dependency
+single-file live dashboard renders the lot (``dashboard.py``,
+``GET /dashboard``).
 """
 
 from .cost import (
@@ -39,6 +47,13 @@ from .device import (
     device_memory_stats,
     sample_device_memory,
 )
+from .anomaly import (
+    AnomalyMonitor,
+    AnomalyRule,
+    EwmaBaseline,
+    build_default_rules,
+)
+from .dashboard import DASHBOARD_HTML, render_dashboard
 from .metrics import (
     DEFAULT_LATENCY_BUCKETS_S,
     DEFAULT_TOKEN_BUCKETS_S,
@@ -48,6 +63,7 @@ from .metrics import (
 from .recorder import FlightRecorder, get_recorder
 from .slo import SloTracker, resolve_slo_knobs
 from .spans import SpanTracker, get_span_tracker
+from .timeseries import MetricsSampler, SeriesStore, resolve_series_knobs
 from .trace import NULL_SPAN, RequestSpan, Tracer
 from .watchdog import EngineWatchdog, resolve_watchdog_knobs
 
@@ -76,4 +92,13 @@ __all__ = [
     "resolve_slo_knobs",
     "EngineWatchdog",
     "resolve_watchdog_knobs",
+    "SeriesStore",
+    "MetricsSampler",
+    "resolve_series_knobs",
+    "AnomalyMonitor",
+    "AnomalyRule",
+    "EwmaBaseline",
+    "build_default_rules",
+    "DASHBOARD_HTML",
+    "render_dashboard",
 ]

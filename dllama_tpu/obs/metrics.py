@@ -16,14 +16,23 @@ a Prometheus client that the serving path needs. Design constraints:
   0.0.4 (``# HELP``/``# TYPE`` per family, cumulative ``_bucket{le=}``
   rows + ``_sum``/``_count`` for histograms) so a stock Prometheus server
   can scrape ``GET /metrics`` unmodified.
+* **One refresh path.** Gauges that are computed on demand (the windowed
+  ``dllama_slo_*`` values, per-chip device memory, compiled-step cost)
+  register a named *refresh hook* on the registry; every reader that
+  wants current values — the ``/metrics`` scrape handler AND the
+  time-series sampler (``timeseries.py``) — calls
+  ``run_refresh_hooks()`` first. Before this existed the refresh lived
+  inside the scrape handler only, so any non-scrape reader saw whatever
+  the last scrape left behind (the PR 9 stale-gauge bug).
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from bisect import bisect_left
-from typing import Sequence
+from typing import Callable, Sequence
 
 # serving latencies (TTFT, queue wait, prefill, dispatch): 1 ms .. 60 s
 DEFAULT_LATENCY_BUCKETS_S = (
@@ -267,6 +276,11 @@ class _Family:
                 )
 
 
+# histogram quantiles the sampler snapshots per family child (series
+# names: <name>_p50{...} / <name>_p99{...}, gauge-kind)
+SAMPLE_QUANTILES: tuple[tuple[float, str], ...] = ((0.5, "p50"), (0.99, "p99"))
+
+
 class MetricsRegistry:
     """Thread-safe registry of metric families; see module docstring."""
 
@@ -276,6 +290,10 @@ class MetricsRegistry:
         self.enabled = enabled
         self._lock = threading.RLock()
         self._families: dict[str, _Family] = {}
+        # name -> callable; insertion-ordered, keyed so rebuilding an
+        # ApiState/engine against the shared default registry REPLACES
+        # its hook instead of stacking a dead closure per rebuild
+        self._refresh_hooks: dict[str, Callable[[], object]] = {}
 
     def enable(self) -> None:
         self.enabled = True
@@ -323,6 +341,37 @@ class MetricsRegistry:
     ) -> _Family:
         return self._get(name, help, "histogram", labelnames, buckets)
 
+    # -- refresh hooks -----------------------------------------------------
+
+    def add_refresh_hook(self, name: str, fn: Callable[[], object]) -> None:
+        """Register (or replace) the named on-demand gauge refresher.
+        Hooks run in registration order from ``run_refresh_hooks()``."""
+        with self._lock:
+            self._refresh_hooks[name] = fn
+
+    def remove_refresh_hook(self, name: str) -> None:
+        with self._lock:
+            self._refresh_hooks.pop(name, None)
+
+    def run_refresh_hooks(self) -> None:
+        """Bring every on-demand gauge current. Called by BOTH readers —
+        the ``/metrics`` scrape handler and the time-series sampler — so
+        they see the same values. Hooks run outside the registry lock
+        (they set gauges, which retakes it) and a failing hook logs and
+        is skipped: one broken refresher must not take down the scrape
+        or the sampler thread."""
+        if not self.enabled:
+            return
+        with self._lock:
+            hooks = list(self._refresh_hooks.items())
+        for name, fn in hooks:
+            try:
+                fn()
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "metrics refresh hook %r failed", name
+                )
+
     def render(self) -> str:
         out: list[str] = []
         with self._lock:
@@ -330,11 +379,47 @@ class MetricsRegistry:
                 fam.render(out)
         return "\n".join(out) + "\n" if out else ""
 
+    def flat_values(self) -> dict[str, tuple[str, float]]:
+        """Every current sample as ``series name -> (kind, value)`` — the
+        time-series sampler's view of the registry. Counters and gauges
+        contribute one entry per labelled child
+        (``name{label="v"}``); a histogram child contributes its
+        cumulative ``_count``/``_sum`` (counter-kind, rate-able) plus the
+        :data:`SAMPLE_QUANTILES` estimates (``_p50``/``_p99``,
+        gauge-kind). Does NOT run the refresh hooks — callers that want
+        current on-demand gauges call :meth:`run_refresh_hooks` first."""
+        out: dict[str, tuple[str, float]] = {}
+        with self._lock:
+            families = list(self._families.values())
+        for fam in families:
+            with self._lock:
+                children = sorted(fam._children.items())
+            for key, child in children:
+                labels = fam._label_str(key)
+                if isinstance(child, _Histogram):
+                    out[f"{fam.name}_count{labels}"] = (
+                        "counter", float(child.count),
+                    )
+                    out[f"{fam.name}_sum{labels}"] = (
+                        "counter", float(child.sum),
+                    )
+                    for q, suffix in SAMPLE_QUANTILES:
+                        v = child.percentile(q)
+                        if v is not None:
+                            out[f"{fam.name}_{suffix}{labels}"] = (
+                                "gauge", float(v),
+                            )
+                else:
+                    out[f"{fam.name}{labels}"] = (fam.type, float(child.value))
+        return out
+
     def reset(self) -> None:
-        """Drop all families (tests/bench only — live scrapers rely on
-        counters being monotonic for the process lifetime)."""
+        """Drop all families and refresh hooks (tests/bench only — live
+        scrapers rely on counters being monotonic for the process
+        lifetime)."""
         with self._lock:
             self._families.clear()
+            self._refresh_hooks.clear()
 
 
 _DEFAULT = MetricsRegistry(enabled=os.environ.get("DLLAMA_OBS", "1") != "0")

@@ -18,8 +18,18 @@ Capability port of the reference's `dllama-api` (src/dllama-api.cpp):
   (``?request_id=`` narrows to one request and adds its millisecond
   accounting; obs/spans.py);
 * ``GET /v1/debug/slo`` — windowed SLO attainment / goodput snapshot
-  (obs/slo.py). ``/v1/health`` reports ``status: degraded`` while the
-  engine watchdog (obs/watchdog.py) detects a stall.
+  (obs/slo.py);
+* ``GET /v1/debug/series`` — in-process metrics time-series
+  (obs/timeseries.py; ``?name=&window=`` for points, bare for the index);
+* ``GET /dashboard`` — zero-dependency live dashboard, a single
+  self-contained HTML page of canvas sparklines (obs/dashboard.py);
+* ``POST /v1/debug/profile`` — on-demand ``jax.profiler`` capture
+  ({"seconds": 2.0}; hardened, CPU-safe; 409 while one runs).
+
+``/v1/health`` reports ``status: degraded`` while the engine watchdog
+(obs/watchdog.py) detects a stall OR the anomaly monitor
+(obs/anomaly.py) has an active signal; ``degraded_reasons`` lists every
+contributing source.
 
 The reference hand-rolls an HTTP/1.1 server over raw sockets; here Python's
 stdlib ThreadingHTTPServer carries the protocol. With a batch_size == 1
@@ -46,11 +56,18 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
 from ..analysis.lockwatch import make_condition, make_lock
+from ..obs.anomaly import AnomalyMonitor, build_default_rules
+from ..obs.dashboard import DASHBOARD_CONTENT_TYPE, render_dashboard
 from ..obs.device import compare_with_analytic, sample_device_memory
 from ..obs.metrics import DEFAULT_TOKEN_BUCKETS_S, get_registry
 from ..obs.recorder import get_recorder
 from ..obs.slo import SloTracker, resolve_slo_knobs
 from ..obs.spans import get_span_tracker
+from ..obs.timeseries import (
+    MetricsSampler,
+    SeriesStore,
+    resolve_series_knobs,
+)
 from ..obs.trace import NULL_SPAN, Tracer
 from ..obs.watchdog import EngineWatchdog, resolve_watchdog_knobs
 from ..tokenizer import (
@@ -815,6 +832,7 @@ class ApiState:
         kv_pool_pages: int = 0,
         slo_ttft_ms: float | None = None,
         slo_tpot_ms: float | None = None,
+        series_retention: float | None = None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
@@ -834,6 +852,28 @@ class ApiState:
         self.slo = SloTracker(
             ttft_target_ms=ttft_ms, tpot_target_ms=tpot_ms
         )
+        # one refresh path for every on-demand gauge: the /metrics scrape
+        # and the series sampler both call run_refresh_hooks(), so the SLO
+        # windows / device memory / step cost are never scrape-only stale.
+        # Keyed registration: test churn rebuilds ApiState against the
+        # process-global registry, and each rebuild REPLACES the hooks.
+        self.obs.add_refresh_hook(
+            "device_memory", lambda: sample_device_memory(self.obs)
+        )
+        self.obs.add_refresh_hook("slo", self.slo.snapshot)
+        # in-process time-series store + sampler thread + anomaly monitor
+        # (obs/timeseries.py, obs/anomaly.py): /v1/debug/series and the
+        # /dashboard sparklines read the store; the anomaly monitor rides
+        # the sampler tick and feeds /v1/health's degraded status
+        retention_s, interval_s = resolve_series_knobs(series_retention)
+        self.series = SeriesStore(
+            interval_s=interval_s, retention_s=retention_s
+        )
+        self.sampler = MetricsSampler(self.series)
+        self.anomaly = AnomalyMonitor(build_default_rules(self.series))
+        self.sampler.on_sample.append(self.anomaly.evaluate)
+        # POST /v1/debug/profile concurrency guard (one capture at a time)
+        self.profile_lock = make_lock("api.profile")
         # analytic per-chip accounting, computed once: /v1/debug/memory
         # compares it against the live device.memory_stats() snapshot
         from ..utils.telemetry import memory_report
@@ -983,6 +1023,8 @@ class ApiState:
         self.m_lanes_total.set(
             engine.batch_size if self.scheduler is not None else 1
         )
+        # sampler last: every gauge/hook it snapshots now exists
+        self.sampler.start()
 
     # -- completion ------------------------------------------------------
 
@@ -1243,6 +1285,9 @@ _KNOWN_PATHS = frozenset(
         "/v1/debug/kv",
         "/v1/debug/timeline",
         "/v1/debug/slo",
+        "/v1/debug/series",
+        "/v1/debug/profile",
+        "/dashboard",
         "/metrics",
         "/health",
         "/healthz",
@@ -1306,11 +1351,10 @@ def make_handler(state: ApiState):
                     }
                 )
             elif path == "/metrics":
-                # refresh the per-chip memory gauges and the windowed SLO
-                # gauges at scrape time (a no-op list walk on backends
-                # without memory_stats)
-                sample_device_memory(state.obs)
-                state.slo.snapshot()
+                # the shared refresh path (device memory, SLO windows,
+                # step cost) — the series sampler runs the SAME hooks, so
+                # scrape and sampler always agree
+                state.obs.run_refresh_hooks()
                 body = state.obs.render().encode("utf-8")
                 self.send_response(200)
                 self.send_header("Content-Type", state.obs.CONTENT_TYPE)
@@ -1341,13 +1385,28 @@ def make_handler(state: ApiState):
                     "queue_depth": queued,
                     "cache_epoch": state.engine.cache_epoch,
                 }
+                # degraded status COMPOSES: the watchdog (hard stall) and
+                # the anomaly monitor (soft baseline deviation) each
+                # contribute reasons — never last-writer-wins
+                degraded_reasons: list[str] = []
                 wd = state.watchdog
                 if wd is not None and wd.degraded:
-                    # a stalled engine is still accepting connections —
-                    # health says DEGRADED so a probe/router can act on
-                    # the watchdog's verdict
+                    wd_status = wd.status()
+                    payload["watchdog"] = wd_status
+                    degraded_reasons.append(
+                        f"watchdog:{wd_status.get('reason')}"
+                    )
+                if state.anomaly.degraded:
+                    payload["anomaly"] = state.anomaly.status()
+                    degraded_reasons.extend(
+                        f"anomaly:{s}"
+                        for s in state.anomaly.active_signals()
+                    )
+                if degraded_reasons:
+                    # a degraded engine is still accepting connections —
+                    # health says so, so a probe/router can act on it
                     payload["status"] = "degraded"
-                    payload["watchdog"] = wd.status()
+                    payload["degraded_reasons"] = degraded_reasons
                 self._json(payload)
             elif path == "/v1/debug/recorder":
                 # the engine flight recorder's ring: the last N
@@ -1394,6 +1453,47 @@ def make_handler(state: ApiState):
                 self._json(state.spans.chrome_trace(request_id=rid))
             elif path == "/v1/debug/slo":
                 self._json(state.slo.snapshot())
+            elif path == "/v1/debug/series":
+                # in-process time-series: no ?name= lists the tracked
+                # series (plus the anomaly monitor's status); with
+                # ?name=&window= it returns the trailing points
+                name = (params.get("name") or [None])[0]
+                if name is None:
+                    self._json(
+                        {
+                            "names": state.series.names(),
+                            "interval_s": state.series.interval_s,
+                            "retention_s": state.series.retention_s,
+                            "anomaly": state.anomaly.status(),
+                        }
+                    )
+                    return
+                try:
+                    window = float(
+                        (params.get("window") or ["300"])[0]
+                    )
+                except ValueError:
+                    self._json(
+                        {"error": {"message": "bad window"}}, 400
+                    )
+                    return
+                result = state.series.query(name, window)
+                if result is None:
+                    self._json(
+                        {"error": {"message": f"no series {name!r}"}}, 404
+                    )
+                    return
+                self._json(result)
+            elif path == "/dashboard":
+                # single-file live dashboard (obs/dashboard.py): inline
+                # HTML/JS sparklines over /v1/debug/series, no external
+                # assets
+                body = render_dashboard()
+                self.send_response(200)
+                self.send_header("Content-Type", DASHBOARD_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif path in ("/health", "/healthz"):
                 self._json({"status": "ok"})
             else:
@@ -1401,7 +1501,11 @@ def make_handler(state: ApiState):
 
         def do_POST(self):
             self._count_request()
-            if self.path != "/v1/chat/completions":
+            path = self.path.partition("?")[0]
+            if path == "/v1/debug/profile":
+                self._profile()
+                return
+            if path != "/v1/chat/completions":
                 self.send_error(404, "Not Found")
                 return
             try:
@@ -1438,6 +1542,59 @@ def make_handler(state: ApiState):
                         self._json(response)
                 finally:
                     state.m_lanes_active.set(0)
+
+        def _profile(self) -> None:
+            """POST /v1/debug/profile — on-demand jax.profiler capture.
+
+            Body: {"seconds": 2.0, "out_dir": "..."} (both optional).
+            One capture at a time (409 while another runs); the hardened
+            telemetry.profile() context logs-and-continues on backends
+            where tracing is unavailable, so this is CPU-safe."""
+            import os
+            import tempfile
+
+            from ..utils import telemetry
+
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                seconds = float(body.get("seconds", 2.0))
+                out_dir = body.get("out_dir")
+            except (ValueError, TypeError) as e:
+                self._json({"error": {"message": f"bad request: {e}"}}, 400)
+                return
+            if not (0.0 < seconds <= 60.0):
+                self._json(
+                    {"error": {"message": "seconds must be in (0, 60]"}},
+                    400,
+                )
+                return
+            if not out_dir:
+                out_dir = os.path.join(
+                    tempfile.gettempdir(),
+                    f"dllama-profile-{uuid.uuid4().hex[:8]}",
+                )
+            if not state.profile_lock.acquire(blocking=False):
+                self._json(
+                    {"error": {"message": "a capture is already running"}},
+                    409,
+                )
+                return
+            try:
+                with telemetry.profile(out_dir):
+                    time.sleep(seconds)
+            finally:
+                state.profile_lock.release()
+            n_files = 0
+            for _, _, files in os.walk(out_dir):
+                n_files += len(files)
+            state.recorder.record(
+                "profile_capture", log_dir=out_dir, seconds=seconds,
+                n_files=n_files,
+            )
+            self._json(
+                {"log_dir": out_dir, "seconds": seconds, "n_files": n_files}
+            )
 
         def _complete_lanes(self, params: InferenceParams) -> None:
             """Concurrent path: submit to the lane scheduler and relay its
@@ -1601,6 +1758,7 @@ def serve(
     timeline_out: str | None = None,
     slo_ttft_ms: float | None = None,
     slo_tpot_ms: float | None = None,
+    series_retention: float | None = None,
 ):
     block, chunk = resolve_lane_knobs(lane_block_size, admission_chunk)
     page_size, pool_pages = resolve_kv_knobs(kv_page_size, kv_pool_pages)
@@ -1616,6 +1774,7 @@ def serve(
         kv_pool_pages=pool_pages,
         slo_ttft_ms=slo_ttft_ms,
         slo_tpot_ms=slo_tpot_ms,
+        series_retention=series_retention,
     )
     if postmortem_dir:
         # a crashed scheduler loop / engine step dumps the event ring here
@@ -1634,6 +1793,9 @@ def serve(
             state.scheduler.stop()
         if state.watchdog is not None:
             state.watchdog.stop()
+        # join the sampler so a closed server (and test churn) never
+        # leaks a thread mutating the shared registry
+        state.sampler.stop()
         if timeline_out:
             state.spans.flush()
 
@@ -1694,6 +1856,7 @@ def main(argv=None) -> None:
                 timeline_out=args.timeline_out,
                 slo_ttft_ms=args.slo_ttft_ms,
                 slo_tpot_ms=args.slo_tpot_ms,
+                series_retention=args.series_retention,
             )
             server.serve_forever()
             return

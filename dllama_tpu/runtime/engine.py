@@ -413,6 +413,14 @@ class InferenceEngine:
         self._inflight: dict = {}  # key -> threading.Event
         self._compile_origin: dict = {}
         self._compile_seconds: dict = {}  # key -> AOT build wall seconds
+        # XLA cost analysis memoized per compiled key: the series sampler
+        # refreshes the cost gauges at ~1 Hz via the registry's
+        # "engine.cost" hook, and cost_analysis() on every program every
+        # tick would dwarf the tick itself. "unavailable" (None) results
+        # are NOT cached — a lazily jitted program exposes its executable
+        # only after its first call.
+        self._cost_cache: dict = {}
+        self.obs.add_refresh_hook("engine.cost", self.cost_report)
 
         if moe_decode_dedup == "auto":
             # decision boundary from the routing-correlation study
@@ -1948,7 +1956,11 @@ class InferenceEngine:
             seconds = dict(self._compile_seconds)
         out = []
         for key, fn in items:
-            cost = extract_cost(fn)
+            cost = self._cost_cache.get(key)
+            if cost is None:
+                cost = extract_cost(fn)
+                if cost is not None:
+                    self._cost_cache[key] = cost
             out.append(
                 {
                     "key": list(key),

@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Bench trajectory: append each run's BENCH_*.json to a history dir and
+diff it against the previous run.
+
+bench.py already writes machine-readable ``BENCH_<section>.json``
+summaries (DECODE / TTFT / LANES / SWEEP / SERVING) at the end of every
+run; this script turns those isolated snapshots into a trajectory:
+
+* append the current run — tagged with a git SHA and a timestamp — as
+  one JSON record under ``artifacts/bench_history/``;
+* print a per-metric delta table against the previous recorded run;
+* exit non-zero when a WATCHED latency metric (decode step p50, TTFT
+  p50) regressed by more than ``--threshold`` (default 15%), unless
+  ``--warn-only`` (the CI soft gate: noisy shared runners must not turn
+  a perf wiggle into a red build).
+
+The library functions take the timestamp and SHA as ARGUMENTS — only
+``main()`` reads the real clock and the git repo — so tests drive the
+whole append/diff/regression path deterministically.
+
+Usage:
+    python scripts/bench_diff.py                 # hard gate
+    python scripts/bench_diff.py --warn-only     # CI soft gate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+SECTIONS = ("DECODE", "TTFT", "LANES", "SWEEP", "SERVING")
+
+# metric -> direction; "lower" means an INCREASE past the threshold is a
+# regression. These are the two latencies the ISSUE gates on; everything
+# else is reported but never fails the run.
+WATCHED: dict[str, str] = {
+    "DECODE.step_ms.p50": "lower",
+    "TTFT.ttft_ms_p50": "lower",
+    "SERVING.ttft_ms_p50": "lower",
+}
+
+
+def load_sections(bench_dir: str) -> dict[str, dict]:
+    """The BENCH_<section>.json files present in ``bench_dir``."""
+    out: dict[str, dict] = {}
+    for section in SECTIONS:
+        path = os.path.join(bench_dir, f"BENCH_{section}.json")
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                out[section] = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench-diff: skipping unreadable {path}: {e}")
+    return out
+
+
+def flatten(payload: object, prefix: str = "") -> dict[str, float]:
+    """Numeric leaves of a (nested) section payload as dotted keys."""
+    out: dict[str, float] = {}
+    if isinstance(payload, bool):
+        return out
+    if isinstance(payload, (int, float)):
+        out[prefix] = float(payload)
+        return out
+    if isinstance(payload, dict):
+        for k, v in sorted(payload.items()):
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.update(flatten(v, key))
+    return out
+
+
+def run_record(
+    sections: dict[str, dict], git_sha: str, timestamp: float
+) -> dict:
+    return {
+        "git_sha": git_sha,
+        "timestamp": timestamp,
+        "sections": sections,
+    }
+
+
+def append_history(
+    history_dir: str, record: dict
+) -> str:
+    """Write ``record`` as ``<timestamp>-<sha>.json`` under
+    ``history_dir`` (created on demand); lexicographic filename order is
+    chronological order."""
+    os.makedirs(history_dir, exist_ok=True)
+    sha = re.sub(r"[^0-9a-zA-Z]", "", record["git_sha"]) or "unknown"
+    name = f"{int(record['timestamp']):013d}-{sha}.json"
+    path = os.path.join(history_dir, name)
+    with open(path, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def previous_record(history_dir: str, exclude: str) -> dict | None:
+    """The newest history record other than ``exclude`` (the one just
+    written)."""
+    if not os.path.isdir(history_dir):
+        return None
+    names = sorted(
+        n for n in os.listdir(history_dir)
+        if n.endswith(".json")
+        and os.path.join(history_dir, n) != exclude
+    )
+    for name in reversed(names):
+        try:
+            with open(os.path.join(history_dir, name)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def diff_rows(
+    prev: dict, cur: dict
+) -> list[tuple[str, float | None, float | None, float | None]]:
+    """(metric, prev, cur, delta_pct) per numeric metric in either run;
+    delta_pct is None when either side is missing or prev is 0."""
+    pf = flatten(prev.get("sections", {}))
+    cf = flatten(cur.get("sections", {}))
+    rows = []
+    for key in sorted(set(pf) | set(cf)):
+        p, c = pf.get(key), cf.get(key)
+        delta = (
+            (c - p) / abs(p) * 100.0
+            if p is not None and c is not None and p != 0
+            else None
+        )
+        rows.append((key, p, c, delta))
+    return rows
+
+
+def regressions(
+    prev: dict, cur: dict, threshold: float = 0.15
+) -> list[str]:
+    """WATCHED metrics that moved the wrong way past ``threshold``."""
+    pf = flatten(prev.get("sections", {}))
+    cf = flatten(cur.get("sections", {}))
+    out = []
+    for key, direction in WATCHED.items():
+        p, c = pf.get(key), cf.get(key)
+        if p is None or c is None or p <= 0:
+            continue
+        worse = c > p * (1.0 + threshold) if direction == "lower" else (
+            c < p * (1.0 - threshold)
+        )
+        if worse:
+            out.append(
+                f"{key}: {p:g} -> {c:g} "
+                f"({(c - p) / p * 100.0:+.1f}% past the "
+                f"{threshold * 100.0:.0f}% gate)"
+            )
+    return out
+
+
+def render_table(
+    rows: list[tuple[str, float | None, float | None, float | None]]
+) -> str:
+    def fmt(v: float | None) -> str:
+        return "-" if v is None else f"{v:g}"
+
+    lines = [f"{'metric':<52} {'prev':>12} {'cur':>12} {'delta':>9}"]
+    for key, p, c, d in rows:
+        delta = "-" if d is None else f"{d:+.1f}%"
+        lines.append(f"{key:<52} {fmt(p):>12} {fmt(c):>12} {delta:>9}")
+    return "\n".join(lines)
+
+
+def git_short_sha() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except (OSError, subprocess.SubprocessError):
+        return "unknown"
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="bench-diff", description=__doc__)
+    parser.add_argument(
+        "--bench-dir", default=".",
+        help="directory holding the BENCH_*.json files (default: .)",
+    )
+    parser.add_argument(
+        "--history-dir", default="artifacts/bench_history",
+        help="history directory runs append to",
+    )
+    parser.add_argument(
+        "--threshold", type=float, default=0.15,
+        help="regression gate as a fraction (default 0.15 = 15%%)",
+    )
+    parser.add_argument(
+        "--warn-only", action="store_true",
+        help="report regressions but exit 0 (the CI soft gate)",
+    )
+    parser.add_argument("--git-sha", default=None)
+    parser.add_argument("--timestamp", type=float, default=None)
+    args = parser.parse_args(argv)
+
+    sections = load_sections(args.bench_dir)
+    if not sections:
+        print(f"bench-diff: no BENCH_*.json in {args.bench_dir}; nothing to do")
+        return 0
+    sha = args.git_sha if args.git_sha else git_short_sha()
+    ts = args.timestamp if args.timestamp is not None else time.time()
+    record = run_record(sections, sha, ts)
+    path = append_history(args.history_dir, record)
+    print(f"bench-diff: recorded {path}")
+    prev = previous_record(args.history_dir, exclude=path)
+    if prev is None:
+        print("bench-diff: first recorded run; no diff")
+        return 0
+    print(
+        f"bench-diff: vs {prev.get('git_sha', '?')} "
+        f"@ {prev.get('timestamp', '?')}"
+    )
+    print(render_table(diff_rows(prev, record)))
+    regs = regressions(prev, record, args.threshold)
+    if regs:
+        for r in regs:
+            print(f"bench-diff: REGRESSION {r}")
+        if args.warn_only:
+            print("bench-diff: --warn-only set; not failing the run")
+            return 0
+        return 1
+    print("bench-diff: no watched regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

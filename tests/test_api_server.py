@@ -1119,3 +1119,312 @@ def test_watchdog_trips_on_injected_stall(obs_server, tmp_path):
         wd._clock = old_clock
         wd.recorder.postmortem_dir = old_dir
         wd.check_once()  # clear any degraded state with the real clock
+
+
+# -- time-series store, /dashboard, anomaly detection (obs/timeseries,
+# obs/anomaly, obs/dashboard) ------------------------------------------------
+
+
+def _post_json(srv, path, payload):
+    req = urllib.request.Request(
+        _url(srv) + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def test_debug_series_index_and_query(obs_server):
+    """/v1/debug/series with no ?name= lists the tracked series plus the
+    anomaly monitor's status; with ?name=&window= it serves the trailing
+    points the dashboard sparklines poll."""
+    state = obs_server.state
+    # one deterministic tick so the store is populated regardless of the
+    # background sampler's phase
+    state.sampler.sample_once()
+    idx = _get_json(obs_server, "/v1/debug/series")
+    assert idx["interval_s"] == state.series.interval_s
+    assert idx["retention_s"] == state.series.retention_s
+    assert "dllama_lanes_active" in idx["names"]
+    assert "dllama_queue_depth" in idx["names"]
+    # the scrape-only SLO gauges ride the shared refresh hooks into the
+    # store too (the stale-gauge fix: sampler and scraper run the SAME
+    # refresh path)
+    assert any(n.startswith("dllama_slo_goodput_tokens_per_s")
+               for n in idx["names"])
+    anom = idx["anomaly"]
+    assert anom["enabled"] is True and anom["n_rules"] >= 5
+    assert {"decode_stall", "ttft", "tpot", "kv_free_slope", "goodput"} <= (
+        set(anom["baselines"])
+    )
+
+    res = _get_json(
+        obs_server, "/v1/debug/series?name=dllama_lanes_active&window=60")
+    assert res["name"] == "dllama_lanes_active"
+    assert res["kind"] == "gauge" and res["tier"] == "1s"
+    assert res["points"] and all(len(p) == 2 for p in res["points"])
+    ts = [p[0] for p in res["points"]]
+    assert ts == sorted(ts)
+
+
+def test_debug_series_bad_window_and_missing_series(obs_server):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get_json(
+            obs_server,
+            "/v1/debug/series?name=dllama_lanes_active&window=bogus")
+    assert exc.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get_json(obs_server, "/v1/debug/series?name=no_such_series")
+    assert exc.value.code == 404
+    assert "no series" in json.loads(exc.value.read())["error"]["message"]
+
+
+def test_dashboard_serves_self_contained_page(obs_server):
+    """GET /dashboard is a single self-contained HTML page — inline CSS,
+    inline JS, canvas sparklines, polling only same-origin endpoints (the
+    air-gap promise the dashboard-static dlint rule enforces)."""
+    with urllib.request.urlopen(_url(obs_server) + "/dashboard",
+                                timeout=30) as r:
+        ctype = r.headers["Content-Type"]
+        html = r.read().decode("utf-8")
+    assert ctype.startswith("text/html")
+    assert "<canvas" in html and "<script>" in html
+    # it polls the in-process endpoints, nothing else
+    assert "/v1/debug/series" in html and "/v1/health" in html
+    low = html.lower()
+    assert "http://" not in low and "https://" not in low
+    assert "<script src" not in low and "@import" not in low
+    assert 'src="//' not in low and 'href="//' not in low
+
+
+def test_dashboard_series_reflect_fake_clock_traffic(obs_server):
+    """The acceptance loop, closed end-to-end: real traffic lands in the
+    registry, injected fake-clock sampler ticks snapshot it into the
+    store, and the exact queries the dashboard's sparklines poll
+    (/v1/debug/series?name=&window=) serve those points back over HTTP."""
+    state = obs_server.state
+    state.sampler.stop()  # only the injected fake-clock ticks below
+    try:
+        with _post(_url(obs_server), {
+            "messages": [{"role": "user", "content": "draw me"}],
+            "max_tokens": 5, "temperature": 0,
+        }) as r:
+            assert json.loads(r.read())["object"] == "chat.completion"
+        base = time.monotonic() + 1e6  # newer than every real-clock tick
+        ticks = [base + i for i in range(5)]
+        for t in ticks:
+            state.sampler.sample_once(now=t)
+        for name in ("dllama_lanes_active", "dllama_queue_depth",
+                     "dllama_ttft_seconds_p50"):
+            res = _get_json(
+                obs_server, f"/v1/debug/series?name={name}&window=60")
+            assert [p[0] for p in res["points"]] == ticks, name
+        # the TTFT sparkline really reflects the request served above
+        res = _get_json(
+            obs_server,
+            "/v1/debug/series?name=dllama_ttft_seconds_p50&window=60")
+        assert all(v > 0 for _, v in res["points"])
+    finally:
+        state.sampler.start()
+
+
+def test_debug_profile_endpoint(obs_server, tmp_path):
+    """POST /v1/debug/profile captures an on-demand profile (CPU-safe:
+    the hardened telemetry.profile logs-and-continues where tracing is
+    unavailable), validates the capture length, and serializes captures
+    through the non-blocking profile lock."""
+    state = obs_server.state
+    b_events = len(state.recorder.events(kind="profile_capture"))
+    out = str(tmp_path / "prof")
+    data = _post_json(obs_server, "/v1/debug/profile",
+                      {"seconds": 0.05, "out_dir": out})
+    assert data["log_dir"] == out and data["seconds"] == 0.05
+    assert data["n_files"] >= 0
+    events = state.recorder.events(kind="profile_capture")
+    assert len(events) == b_events + 1
+    assert events[-1]["log_dir"] == out
+
+    # out-of-range capture lengths are rejected before any tracing
+    for bad in (0, -1, 61):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post_json(obs_server, "/v1/debug/profile", {"seconds": bad})
+        assert exc.value.code == 400
+
+    # one capture at a time: while the lock is held the endpoint is 409
+    assert state.profile_lock.acquire(blocking=False)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post_json(obs_server, "/v1/debug/profile", {"seconds": 0.05})
+        assert exc.value.code == 409
+    finally:
+        state.profile_lock.release()
+
+
+def test_anomaly_fires_and_recovers_through_server(obs_server):
+    """The anomaly acceptance bar, on the live monitor under a fake
+    clock: a signal leaving its baseline fires exactly one
+    dllama_anomaly_total{signal=} increment (visible in a /metrics
+    scrape), flips /v1/health to degraded with the anomaly reason, and
+    recovers back to ok after the calm-tick hysteresis — all
+    deterministic (edge-triggered, frozen baseline while active)."""
+    from dllama_tpu.obs.anomaly import AnomalyRule, _RuleState
+
+    state = obs_server.state
+    mon = state.anomaly
+    val = {"v": 1.0}
+    rule = AnomalyRule(
+        "test_e2e", lambda: val["v"], direction="high", z_threshold=4.0,
+        min_samples=5, min_abs=0.1, std_floor=1e-3, recover_ticks=2,
+    )
+    counter = mon.m_anomalies.labels(signal="test_e2e")
+    b_count = counter.value
+    b_events = len(state.recorder.events(kind="anomaly"))
+    with mon._lock:
+        mon.rules.append(rule)
+        mon._state["test_e2e"] = _RuleState(rule.alpha)
+    try:
+        # teach the baseline with calm ticks (the background sampler may
+        # interleave more ticks at the same value — also calm, also
+        # teaching — so every outcome below stays deterministic)
+        for i in range(10):
+            mon.evaluate(now=1_000.0 + i)
+        assert "test_e2e" not in mon.active_signals()
+        assert _get_json(obs_server, "/v1/health")["status"] == "ok"
+
+        # the signal leaves its baseline: exactly one edge
+        val["v"] = 100.0
+        mon.evaluate(now=1_020.0)
+        assert "test_e2e" in mon.active_signals()
+        assert counter.value == b_count + 1
+
+        health = _get_json(obs_server, "/v1/health")
+        assert health["status"] == "degraded"
+        assert "anomaly:test_e2e" in health["degraded_reasons"]
+        detail = health["anomaly"]["active"]["test_e2e"]
+        assert detail["z"] >= 4.0 and detail["value"] == 100.0
+        assert detail["active_s"] >= 0
+
+        _, text = _scrape(obs_server)
+        m = re.search(
+            r'^dllama_anomaly_total\{signal="test_e2e"\} ([0-9.]+)$',
+            text, re.M)
+        assert m and float(m.group(1)) == b_count + 1
+        assert _sample(text, "dllama_anomaly_degraded") == 1.0
+
+        # still abnormal on a later tick: edge-triggered, no re-count
+        mon.evaluate(now=1_021.0)
+        assert counter.value == b_count + 1
+        fired = state.recorder.events(kind="anomaly")[b_events:]
+        assert [e for e in fired if e.get("signal") == "test_e2e"]
+
+        # calm again: recover_ticks consecutive calm ticks clear it (the
+        # baseline was frozen at ~1.0, so 1.0 reads as calm immediately)
+        val["v"] = 1.0
+        mon.evaluate(now=1_030.0)
+        mon.evaluate(now=1_031.0)
+        assert "test_e2e" not in mon.active_signals()
+        assert _get_json(obs_server, "/v1/health")["status"] == "ok"
+        recovered = state.recorder.events(kind="anomaly_recovered")
+        assert any(e.get("signal") == "test_e2e" for e in recovered)
+        assert counter.value == b_count + 1  # the episode cost one count
+    finally:
+        with mon._lock:
+            if rule in mon.rules:
+                mon.rules.remove(rule)
+            mon._state.pop("test_e2e", None)
+        mon.g_degraded.set(1.0 if mon.degraded else 0.0)
+
+
+def test_health_degraded_reasons_compose(obs_server):
+    """A watchdog stall AND an active anomaly at once: /v1/health lists
+    BOTH reasons (composition, never last-writer-wins), keeps the
+    surviving reason when one source recovers, and returns to "ok" only
+    when both have cleared."""
+    from dllama_tpu.obs.anomaly import AnomalyRule, _RuleState
+
+    state = obs_server.state
+    wd = state.watchdog
+    mon = state.anomaly
+    old_clock = wd._clock
+    fake = {"t": 50_000.0}
+    # value_fn=None ticks are calm for an ACTIVE rule, so a huge
+    # recover_ticks keeps the background sampler from clearing the
+    # injected episode under the test
+    rule = AnomalyRule("test_compose", lambda: None, recover_ticks=10**6)
+    with mon._lock:
+        mon.rules.append(rule)
+        st = _RuleState(rule.alpha)
+        st.active = True
+        st.since = mon._clock()
+        st.detail = {"signal": "test_compose", "value": 9.0,
+                     "baseline_mean": 1.0, "z": 8.0}
+        mon._state["test_compose"] = st
+    try:
+        wd._clock = lambda: fake["t"]
+        # re-stamp the heartbeat in fake time with idle lanes, so stale
+        # real-clock liveness state from earlier tests can't trip the
+        # scheduler-stalled rule under the fake clock
+        wd.beat(n_active=0, n_admitting=0)
+        wd.dispatch_begin("decode_lanes")  # ...and never ends: a hang
+        fake["t"] += wd.dispatch_timeout_s + 1.0
+        assert wd.check_once() == "dispatch-hung"
+
+        health = _get_json(obs_server, "/v1/health")
+        assert health["status"] == "degraded"
+        reasons = health["degraded_reasons"]
+        assert "watchdog:dispatch-hung" in reasons
+        assert "anomaly:test_compose" in reasons
+        assert health["watchdog"]["degraded"] is True
+        assert "test_compose" in health["anomaly"]["active"]
+
+        # watchdog recovers first: still degraded on the anomaly alone
+        wd.dispatch_end()
+        wd.beat(n_active=0, n_admitting=0)
+        assert wd.check_once() is None
+        health = _get_json(obs_server, "/v1/health")
+        assert health["status"] == "degraded"
+        assert health["degraded_reasons"] == ["anomaly:test_compose"]
+        assert "watchdog" not in health
+
+        # the anomaly clears too: back to ok, no degraded payload at all
+        with mon._lock:
+            mon._state["test_compose"].active = False
+        health = _get_json(obs_server, "/v1/health")
+        assert health["status"] == "ok"
+        assert "degraded_reasons" not in health
+        assert "anomaly" not in health
+    finally:
+        wd.dispatch_end()
+        wd._clock = old_clock
+        wd.check_once()  # clear any degraded state with the real clock
+        with mon._lock:
+            if rule in mon.rules:
+                mon.rules.remove(rule)
+            mon._state.pop("test_compose", None)
+
+
+def test_server_close_joins_sampler_thread(tmp_path):
+    """server_close() joins the named sampler thread: a closed server
+    (and test churn) can never leak a sampler mutating the process-global
+    registry behind the next server's back."""
+    mp, tp_ = str(tmp_path / "m.m"), str(tmp_path / "t.t")
+    cfg = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=8, n_kv_heads=4,
+               head_dim=16, vocab_size=288, seq_len=384)
+    make_tiny_model(mp, weight_type=FloatType.Q40, cfg=cfg)
+    make_tiny_tokenizer(tp_, chat_template="<|start_header_id|>")
+    tok = Tokenizer(tp_)
+    engine = InferenceEngine(
+        mp, tokenizer=tok, tp=1, dtype=jnp.float32, temperature=0.0, seed=3
+    )
+    srv = serve(engine, tok, host="127.0.0.1", port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    sampler = srv.state.sampler
+    t = sampler._thread
+    assert t is not None and t.is_alive()
+    assert t.name == "dllama-series-sampler" and t.daemon
+    srv.shutdown()
+    srv.server_close()
+    assert sampler._thread is None
+    assert not t.is_alive(), "server_close left the sampler running"

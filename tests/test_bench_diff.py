@@ -1,0 +1,100 @@
+"""bench_diff: the BENCH_*.json trajectory, driven deterministically.
+
+The library functions take the git SHA and timestamp as arguments (only
+``main()`` reads the real clock/repo), so the whole
+append → diff → regression-gate path runs under fixed inputs here.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+import bench_diff  # noqa: E402
+
+pytestmark = pytest.mark.fast
+
+
+def _write_bench(d, step_p50=10.0, ttft_p50=200.0):
+    (d / "BENCH_DECODE.json").write_text(json.dumps(
+        {"tok_s": 100.0,
+         "step_ms": {"p50": step_p50, "p90": step_p50 * 1.2,
+                     "max": step_p50 * 1.5}}))
+    (d / "BENCH_TTFT.json").write_text(json.dumps(
+        {"ttft_ms_p50": ttft_p50, "unit": "ms"}))
+
+
+def test_flatten_numeric_leaves_only():
+    flat = bench_diff.flatten({
+        "a": 1, "b": {"c": 2.5, "d": "text", "e": True}, "f": None,
+    })
+    # strings, bools and nulls are not metrics
+    assert flat == {"a": 1.0, "b.c": 2.5}
+
+
+def test_history_append_and_chronological_order(tmp_path):
+    hist = str(tmp_path / "hist")
+    for i, sha in enumerate(("aaa", "bbb", "ccc")):
+        rec = bench_diff.run_record({"DECODE": {"tok_s": float(i)}},
+                                    git_sha=sha, timestamp=1000.0 + i)
+        path = bench_diff.append_history(hist, rec)
+        assert Path(path).exists()
+    prev = bench_diff.previous_record(hist, exclude=path)
+    assert prev["git_sha"] == "bbb"  # newest other than the just-written
+
+
+def test_main_first_run_then_regression_gate(tmp_path, capsys):
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    hist = str(tmp_path / "hist")
+    _write_bench(bench, step_p50=10.0, ttft_p50=200.0)
+    base = ["--bench-dir", str(bench), "--history-dir", hist,
+            "--timestamp", "1000", "--git-sha", "aaa"]
+    assert bench_diff.main(base) == 0
+    assert "first recorded run" in capsys.readouterr().out
+
+    # +10% decode p50: inside the 15% gate, reported but green
+    _write_bench(bench, step_p50=11.0)
+    assert bench_diff.main(
+        ["--bench-dir", str(bench), "--history-dir", hist,
+         "--timestamp", "1100", "--git-sha", "bbb"]) == 0
+    out = capsys.readouterr().out
+    assert "DECODE.step_ms.p50" in out and "no watched regressions" in out
+
+    # +30% decode p50: past the gate -> exit 1; --warn-only -> exit 0
+    _write_bench(bench, step_p50=14.3)
+    assert bench_diff.main(
+        ["--bench-dir", str(bench), "--history-dir", hist,
+         "--timestamp", "1200", "--git-sha", "ccc"]) == 1
+    assert "REGRESSION DECODE.step_ms.p50" in capsys.readouterr().out
+    _write_bench(bench, step_p50=20.0)
+    assert bench_diff.main(
+        ["--bench-dir", str(bench), "--history-dir", hist,
+         "--timestamp", "1300", "--git-sha", "ddd", "--warn-only"]) == 0
+    assert "--warn-only" in capsys.readouterr().out
+
+
+def test_improvement_and_missing_metrics_never_gate(tmp_path):
+    prev = bench_diff.run_record(
+        {"DECODE": {"step_ms": {"p50": 10.0}}, "TTFT": {"ttft_ms_p50": 200.0}},
+        "aaa", 1000.0)
+    # faster decode, TTFT section gone entirely: no regression either way
+    cur = bench_diff.run_record(
+        {"DECODE": {"step_ms": {"p50": 5.0}}}, "bbb", 1100.0)
+    assert bench_diff.regressions(prev, cur) == []
+    rows = bench_diff.diff_rows(prev, cur)
+    by_key = {k: (p, c, d) for k, p, c, d in rows}
+    assert by_key["DECODE.step_ms.p50"][2] == pytest.approx(-50.0)
+    assert by_key["TTFT.ttft_ms_p50"] == (200.0, None, None)
+
+
+def test_no_bench_files_is_a_noop(tmp_path, capsys):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert bench_diff.main(
+        ["--bench-dir", str(empty),
+         "--history-dir", str(tmp_path / "hist")]) == 0
+    assert "nothing to do" in capsys.readouterr().out
+    assert not (tmp_path / "hist").exists()
