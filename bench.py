@@ -539,6 +539,121 @@ def _serving_smoke(n_clients: int) -> dict:
     ttft_off = fanout_round(port_off)
     srv_off.shutdown()
 
+    # model-free speculation (ISSUE 10): a repetitive JSON workload on a
+    # spec-on server vs an identical spec-off server. Greedy streams are
+    # token-exact either way (same bytes, same SSE event count), so the
+    # comparison is pure timing: accepted draft runs amortize one weight
+    # pass over several tokens and decode tok/s must beat the baseline
+    # even on CPU smoke. Pool off on both so prefix sharing can't skew
+    # the per-request timing.
+    # short enough that the prompt leaves decode room inside seq_len;
+    # the greedy continuation settles into a cycle the n-gram drafter
+    # locks onto (acceptance climbs to full-k within a few verifies)
+    spec_prompt = (
+        'Repeat this list forever: {"name": "a", "value": 1}, '
+        '{"name": "b", "value": 2}'
+    )
+
+    def decode_tok_s(srv_, n_rounds: int = 4) -> float:
+        """Median completion tok/s over the warm rounds: completion
+        tokens (from the scheduler's own finish records, not SSE event
+        counts — burst flushes coalesce deltas) divided by the full
+        request wall.  Round 0 pays any residual compiles and is
+        discarded; prefill cost is identical on both servers so the
+        on/off ratio isolates the decode path."""
+        port_ = srv_.server_address[1]
+        rates = []
+        for rnd in range(n_rounds):
+            seen = len(srv_.state.recorder.events(kind="finish"))
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port_, timeout=300
+            )
+            t0_ = time.perf_counter()
+            conn.request(
+                "POST", "/v1/chat/completions",
+                json.dumps({
+                    "messages": [
+                        {"role": "user", "content": spec_prompt}
+                    ],
+                    "max_tokens": 96, "stream": True, "temperature": 0.0,
+                }),
+                {"Content-Type": "application/json"},
+            )
+            for _line in conn.getresponse():
+                pass
+            wall = time.perf_counter() - t0_
+            conn.close()
+            ntok = sum(
+                f["n_completion"]
+                for f in srv_.state.recorder.events(kind="finish")[seen:]
+            )
+            if rnd > 0 and ntok > 0 and wall > 0:
+                rates.append(ntok / wall)
+        return sorted(rates)[len(rates) // 2] if rates else 0.0
+
+    def scrape_port(port_: int) -> str:
+        c = http.client.HTTPConnection("127.0.0.1", port_, timeout=30)
+        c.request("GET", "/metrics")
+        text = c.getresponse().read().decode("utf-8")
+        c.close()
+        return text
+
+    engine_spec_off = InferenceEngine(
+        model_path, tokenizer=tok, batch_size=n_lanes, temperature=0.0
+    )
+    srv_spec_off = serve(
+        engine_spec_off, tok, host="127.0.0.1", port=0, admission_chunk=32,
+        kv_page_size=-1, speculation="off",
+    )
+    threading.Thread(  # dlint: disable=thread-hygiene — serve_forever exits at srv_spec_off.shutdown() below; no handle needed
+        target=srv_spec_off.serve_forever, daemon=True,
+        name="dllama-bench-http-spec-off",
+    ).start()
+    tok_s_off = decode_tok_s(srv_spec_off)
+    srv_spec_off.shutdown()
+
+    engine_spec = InferenceEngine(
+        model_path, tokenizer=tok, batch_size=n_lanes, temperature=0.0
+    )
+    srv_spec = serve(
+        engine_spec, tok, host="127.0.0.1", port=0, admission_chunk=32,
+        kv_page_size=-1, speculation="ngram", spec_k=8,
+    )
+    threading.Thread(  # dlint: disable=thread-hygiene — serve_forever exits at srv_spec.shutdown() below; no handle needed
+        target=srv_spec.serve_forever, daemon=True,
+        name="dllama-bench-http-spec-on",
+    ).start()
+    # registry is process-global: delta the spec counters against a
+    # snapshot taken before this server serves anything
+    pre_spec = scrape_port(srv_spec.server_address[1])
+    tok_s_on = decode_tok_s(srv_spec)
+    post_spec = scrape_port(srv_spec.server_address[1])
+    srv_spec.shutdown()
+    spec_drafted = (
+        metric_value(post_spec, "dllama_spec_draft_tokens_total")
+        - metric_value(pre_spec, "dllama_spec_draft_tokens_total")
+    )
+    spec_accepted = (
+        metric_value(post_spec, "dllama_spec_accepted_tokens_total")
+        - metric_value(pre_spec, "dllama_spec_accepted_tokens_total")
+    )
+    spec_hist = re.search(
+        r"^dllama_spec_accept_length_count (\d+)", post_spec, re.M
+    )
+    speculation = {
+        "acceptance_rate": round(
+            spec_accepted / spec_drafted if spec_drafted else 0.0, 3
+        ),
+        "draft_tokens": int(spec_drafted),
+        "accepted_tokens": int(spec_accepted),
+        "accept_len_hist_count": int(spec_hist.group(1)) if spec_hist else 0,
+        "tok_s_spec_on": round(tok_s_on, 2),
+        "tok_s_spec_off": round(tok_s_off, 2),
+        "speedup_vs_off": round(
+            tok_s_on / tok_s_off if tok_s_off else 0.0, 3
+        ),
+    }
+
     fan_recs = [
         r for r in read_jsonl(trace_path)
         if r.get("submitted_unix", 0) >= fan_t0
@@ -647,6 +762,7 @@ def _serving_smoke(n_clients: int) -> dict:
             metric_value(metrics_text, "dllama_decode_stall_seconds_sum"), 4
         ),
         "prefix_fanout": prefix_fanout,
+        "speculation": speculation,
         "slo": slo,
         "timeline": timeline,
         "series": series,

@@ -72,6 +72,21 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                         "bucket); smaller = tighter inter-token gaps for "
                         "active streams, larger = faster TTFT for the "
                         "incoming prompt")
+    p.add_argument("--speculation", default=None,
+                   choices=("off", "ngram"),
+                   help="model-free speculative decoding on the lane path: "
+                        "'ngram' drafts each greedy lane's continuation "
+                        "from its own context (prompt lookup) and verifies "
+                        "k tokens in one batched dispatch, keeping output "
+                        "token-exact; temperature>0 lanes fall back to the "
+                        "normal decode block per lane (default: env "
+                        "DLLAMA_SPECULATION, else off = pure bypass)")
+    p.add_argument("--spec-k", type=int, default=None,
+                   dest="spec_k", metavar="K",
+                   help="max draft tokens per speculative verify dispatch "
+                        "(compiled shapes are power-of-2 bucketed; each "
+                        "lane's drafter adapts below this on low "
+                        "acceptance; default: env DLLAMA_SPEC_K, else 4)")
     p.add_argument("--tp", type=int, default=0, help="tensor-parallel chips (default: all)")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel chips: shard the KV cache's "

@@ -82,6 +82,7 @@ const DEFAULTS = [
   "dllama_tpot_seconds_p50",
   "dllama_decode_stall_seconds_p99",
   "dllama_kv_pages_free",
+  "dllama_spec_acceptance_rate",
 ];
 let series = DEFAULTS.slice();
 const grid = document.getElementById("grid");

@@ -80,6 +80,13 @@ from ..tokenizer import (
     Tokenizer,
 )
 from .engine import InferenceEngine
+from .spec import (
+    DEFAULT_SPEC_K,
+    NgramDrafter,
+    bucket_for,
+    resolve_spec_knobs,
+    spec_buckets,
+)
 
 
 @dataclass
@@ -287,10 +294,21 @@ class LaneScheduler:
         state: "ApiState",
         block_size: int = 8,
         admission_chunk: int | None = None,
+        speculation: str = "off",
+        spec_k: int = DEFAULT_SPEC_K,
     ):
         self.state = state
         self.engine = state.engine
         self.block_size = max(1, int(block_size))
+        # model-free speculation (runtime/spec.py): greedy lanes draft
+        # from their own context and verify k tokens per dispatch;
+        # "off" is a pure bypass (no drafters, no verify programs)
+        self.spec_on = speculation == "ngram"
+        # verify rows are 1 + k wide and parked lanes write them into
+        # the padding rows, so k is capped by the lane padding
+        self.spec_k = max(1, min(int(spec_k), self.engine._lane_pad - 1))
+        self.spec_buckets = spec_buckets(self.spec_k)
+        self.drafters: dict[int, NgramDrafter] = {}
         # admission chunk budget: at most this many prompt tokens prefill
         # per scheduler tick (0/None = the largest prefill bucket), so the
         # worst-case inter-token gap an active stream sees is one chunk +
@@ -319,9 +337,12 @@ class LaneScheduler:
         self.cv = make_condition("sched.cv")
         self._stop = False
         # build the admission-path programs (every prefill bucket + the
-        # decode block) off-thread NOW, so the first admission under load
-        # doesn't pay a synchronous compile stall
-        self.engine.rehearse_admission(self.block_size)
+        # decode block + the speculative verify buckets) off-thread NOW,
+        # so the first admission under load doesn't pay a synchronous
+        # compile stall
+        self.engine.rehearse_admission(
+            self.block_size, spec_k=self.spec_k if self.spec_on else 0
+        )
         self.thread = threading.Thread(
             target=self._loop, daemon=True, name="dllama-scheduler"
         )
@@ -466,6 +487,7 @@ class LaneScheduler:
                         # stored prefixes stay valid, only the dropped
                         # lanes' page retains need releasing
                         self.kv.release_all_lanes()
+                    self.drafters.clear()
                     self._set_lane_gauge()
                     with self.cv:
                         self.cv.notify_all()
@@ -672,6 +694,11 @@ class LaneScheduler:
             ),
         )
         del self.admitting[lane]
+        if self.spec_on and p.temperature <= 0.0:
+            # greedy lanes only: a sampled lane's next token is not the
+            # argmax the verify pass returns, so it stays on the decode
+            # block (the fallback is per-lane, not per-server)
+            self.drafters[lane] = NgramDrafter(k_max=self.spec_k)
         self._set_lane_gauge()
         state.recorder.record(
             "admit", lane=lane, reused_prefix_tokens=adm.start_pos,
@@ -734,9 +761,148 @@ class LaneScheduler:
             n_completion=ls.job.n_completion,
         )
         self.lanes[lane] = None
+        self.drafters.pop(lane, None)
         self._set_lane_gauge()
         with self.cv:
             self.cv.notify()
+
+    def _consume_token(self, lane: int, t: int) -> bool:
+        """Advance one lane by one generated token — lane state, history,
+        SSE delta, EOS/length detection. Returns False once the lane
+        finished (callers stop feeding it; any remaining burst tokens'
+        KV rows sit beyond the lane's final position and are never
+        published). Shared by the decode-block row loop and the
+        speculative verify path, so an accepted draft run flushes
+        through EXACTLY the same per-token machinery as plain decode —
+        that is what makes spec-on streams byte-identical."""
+        ls = self.lanes[lane]
+        if ls is None:
+            return False
+        ls.pos += 1
+        ls.token = t
+        ls.history.append(t)
+        ls.job.n_completion += 1
+        if ls.job.n_completion == 1:
+            ttft = ls.job.span.mark_first_token()
+            if ttft is not None:
+                self.state.m_ttft.observe(ttft)
+        piece = ls.decoder.decode(t)
+        eos_type = ls.detector.append(t, piece)
+        if eos_type in (EosResult.NOT_EOS, EosResult.EOS):
+            delta = ls.detector.get_delta()
+            if delta:
+                ls.job.buffer += delta
+                ls.job.events.put(("delta", delta))
+            ls.detector.reset()
+        if eos_type == EosResult.EOS:
+            self._finish(lane, "stop")
+            return False
+        if ls.pos >= ls.max_pos:
+            self._finish(lane, "length")
+            return False
+        return True
+
+    def _spec_drafts(self) -> dict[int, list[int]]:
+        """Collect this tick's draft proposals: greedy lanes whose
+        n-gram drafter proposes >=1 token within the lane's remaining
+        budget (both max_tokens and seq_len cap the accepted run)."""
+        out: dict[int, list[int]] = {}
+        seq_len = self.engine.header.seq_len
+        for lane, dr in self.drafters.items():
+            ls = self.lanes[lane]
+            if ls is None:
+                continue
+            dr.update(ls.history)
+            # an accepted run emits up to len(draft)+1 tokens from pos
+            room = min(ls.max_pos, seq_len) - ls.pos - 1
+            if room < 1:
+                continue
+            d = dr.draft(budget=min(self.spec_k, room))
+            if d:
+                out[lane] = d
+        return out
+
+    def _spec_verify(self, drafts: dict[int, list[int]]) -> None:
+        """One batched verify dispatch for every drafting lane: build the
+        shared-width rows [pending, draft..., pads], accept each lane's
+        longest matching prefix + 1 correction token, and flush the run
+        through the normal per-token path. Lanes too close to seq_len
+        for the shared bucket width drop out and decode normally."""
+        st = self.state
+        b = len(self.lanes)
+        seq_len = self.engine.header.seq_len
+        t = 1 + bucket_for(
+            max(len(d) for d in drafts.values()), self.spec_buckets
+        )
+        for lane in list(drafts):
+            ls = self.lanes[lane]
+            if ls is None or ls.pos + t > seq_len:
+                del drafts[lane]
+        if not drafts:
+            return
+        rows = [[0] * t for _ in range(b)]
+        pos = [0] * b
+        act = [False] * b
+        for lane, d in drafts.items():
+            ls = self.lanes[lane]
+            rows[lane] = [ls.token, *d] + [0] * (t - 1 - len(d))
+            pos[lane] = ls.pos
+            act[lane] = True
+        # a verify dispatch IS token progress: it participates in the
+        # same stall window accounting as the decode block
+        now = self._clock()
+        if self._last_decode_end is not None:
+            st.m_decode_stall.observe(now - self._last_decode_end)
+        t0 = time.perf_counter()
+        wd = st.watchdog
+        sp = st.spans.begin(
+            "spec_verify", component="scheduler",
+            n_lanes=len(drafts), t=t,
+        )
+        if wd is not None:
+            wd.dispatch_begin("verify_lanes")
+        try:
+            grid = self.engine.verify_lanes(rows, pos, act)
+        finally:
+            if wd is not None:
+                wd.dispatch_end()
+            st.spans.end(sp)
+        self._last_decode_end = self._clock()
+        dt = time.perf_counter() - t0
+        n_emitted = 0
+        for lane, d in drafts.items():
+            out = grid[lane]
+            # out[0] is the greedy token after the pending one (what a
+            # decode step at this position would emit); out[j] is the
+            # greedy token after draft j-1 — accept while they agree,
+            # then emit out[a] as the correction/continuation token
+            a = 0
+            while a < len(d) and out[a] == d[a]:
+                a += 1
+            emitted = d[:a] + [out[a]]
+            dr = self.drafters.get(lane)
+            if dr is not None:
+                dr.feedback(len(d), a)
+            st.m_spec_drafted.inc(len(d))
+            st.m_spec_accepted.inc(a)
+            st.m_spec_accept_len.observe(float(a))
+            st.recorder.record(
+                "spec_verify", lane=lane, k=len(d), accepted=a,
+                pos=pos[lane],
+            )
+            n_emitted += len(emitted)
+            # the accepted run flushes as a burst, but per-token latency
+            # accounting stays honest: this lane got len(emitted) tokens
+            # for one dispatch's wall time
+            st.m_tpot.observe(dt / len(emitted))
+            for tok in emitted:
+                if not self._consume_token(lane, tok):
+                    break
+        st.slo.note_tokens(n_emitted)
+        if st.m_spec_drafted.value > 0:
+            st.g_spec_rate.set(
+                st.m_spec_accepted.value / st.m_spec_drafted.value
+            )
 
     def _step_block(self) -> None:
         b = len(self.lanes)
@@ -745,7 +911,23 @@ class LaneScheduler:
             ls = self.lanes[lane]
             if ls is not None and ls.job.cancelled:
                 self._finish(lane, "cancelled")
-        active = [ls is not None for ls in self.lanes]
+        if not any(ls is not None for ls in self.lanes):
+            return
+        # speculative verify first: greedy lanes whose drafter proposes a
+        # continuation take ONE batched verify dispatch; everyone else —
+        # temperature>0 lanes, greedy lanes with nothing to propose —
+        # shares the normal decode block in the same tick, so mixed
+        # batches fall back transparently per lane, not per server
+        verified: set[int] = set()
+        if self.spec_on and self.drafters:
+            drafts = self._spec_drafts()
+            if drafts:
+                self._spec_verify(drafts)
+                verified = set(drafts)
+        active = [
+            ls is not None and lane not in verified
+            for lane, ls in enumerate(self.lanes)
+        ]
         if not any(active):
             return
         tokens = [ls.token if ls else 0 for ls in self.lanes]
@@ -782,38 +964,18 @@ class LaneScheduler:
                 len(rows) * sum(1 for a in active if a)
             )
         if not rows:
+            # every decode-side lane is out of sequence space (verified
+            # lanes already advanced this tick and are not touched)
             for lane in range(b):
-                if self.lanes[lane] is not None:
+                if self.lanes[lane] is not None and active[lane]:
                     self._finish(lane, "length")
             return
         for row in rows:
             for lane in range(b):
-                ls = self.lanes[lane]
-                if ls is None or not active[lane]:
+                if self.lanes[lane] is None or not active[lane]:
                     continue
-                t = row[lane]
-                ls.pos += 1
-                ls.token = t
-                ls.history.append(t)
-                ls.job.n_completion += 1
-                if ls.job.n_completion == 1:
-                    ttft = ls.job.span.mark_first_token()
-                    if ttft is not None:
-                        self.state.m_ttft.observe(ttft)
-                piece = ls.decoder.decode(t)
-                eos_type = ls.detector.append(t, piece)
-                if eos_type in (EosResult.NOT_EOS, EosResult.EOS):
-                    delta = ls.detector.get_delta()
-                    if delta:
-                        ls.job.buffer += delta
-                        ls.job.events.put(("delta", delta))
-                    ls.detector.reset()
-                if eos_type == EosResult.EOS:
+                if not self._consume_token(lane, row[lane]):
                     active[lane] = False
-                    self._finish(lane, "stop")
-                elif ls.pos >= ls.max_pos:
-                    active[lane] = False
-                    self._finish(lane, "length")
 
 
 class ApiState:
@@ -833,6 +995,8 @@ class ApiState:
         slo_ttft_ms: float | None = None,
         slo_tpot_ms: float | None = None,
         series_retention: float | None = None,
+        speculation: str = "off",
+        spec_k: int = DEFAULT_SPEC_K,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
@@ -965,6 +1129,31 @@ class ApiState:
             "lane is active — the inter-token stall streaming clients "
             "see; bounded by one admission chunk + one block.",
         )
+        # model-free speculation (runtime/spec.py): draft/accept volume,
+        # the per-dispatch acceptance-length distribution, and the
+        # cumulative acceptance ratio the /dashboard sparkline tracks
+        self.m_spec_drafted = self.obs.counter(
+            "dllama_spec_draft_tokens_total",
+            "Draft tokens proposed by the n-gram speculator across "
+            "verify dispatches.",
+        )
+        self.m_spec_accepted = self.obs.counter(
+            "dllama_spec_accepted_tokens_total",
+            "Draft tokens accepted by batched verification (the greedy "
+            "argmax agreed with the draft at that position).",
+        )
+        self.m_spec_accept_len = self.obs.histogram(
+            "dllama_spec_accept_length",
+            "Accepted draft-prefix length per lane per verify dispatch "
+            "(0 = the first draft token already diverged; each dispatch "
+            "still emits one correction token).",
+            buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0),
+        )
+        self.g_spec_rate = self.obs.gauge(
+            "dllama_spec_acceptance_rate",
+            "Cumulative accepted/drafted token ratio of the n-gram "
+            "speculator (0 until the first verify dispatch).",
+        )
         # request defaults captured once: per-request sampler mutations must
         # not leak into later requests' defaults
         self.default_temperature = engine.temperature
@@ -1016,6 +1205,8 @@ class ApiState:
                 self,
                 block_size=lane_block_size,
                 admission_chunk=admission_chunk,
+                speculation=speculation,
+                spec_k=spec_k,
             )
             if lanes_on
             else None
@@ -1759,9 +1950,12 @@ def serve(
     slo_ttft_ms: float | None = None,
     slo_tpot_ms: float | None = None,
     series_retention: float | None = None,
+    speculation: str | None = None,
+    spec_k: int | None = None,
 ):
     block, chunk = resolve_lane_knobs(lane_block_size, admission_chunk)
     page_size, pool_pages = resolve_kv_knobs(kv_page_size, kv_pool_pages)
+    spec_mode, spec_k_val = resolve_spec_knobs(speculation, spec_k)
     state = ApiState(
         engine,
         tokenizer,
@@ -1775,6 +1969,8 @@ def serve(
         slo_ttft_ms=slo_ttft_ms,
         slo_tpot_ms=slo_tpot_ms,
         series_retention=series_retention,
+        speculation=spec_mode,
+        spec_k=spec_k_val,
     )
     if postmortem_dir:
         # a crashed scheduler loop / engine step dumps the event ring here
@@ -1857,6 +2053,8 @@ def main(argv=None) -> None:
                 slo_ttft_ms=args.slo_ttft_ms,
                 slo_tpot_ms=args.slo_tpot_ms,
                 series_retention=args.series_retention,
+                speculation=args.speculation,
+                spec_k=args.spec_k,
             )
             server.serve_forever()
             return

@@ -1039,14 +1039,18 @@ class InferenceEngine:
         )
         return step
 
-    def rehearse_admission(self, block_size: int | None = None) -> None:
+    def rehearse_admission(
+        self, block_size: int | None = None, spec_k: int = 0
+    ) -> None:
         """Pre-compile the admission-path programs in the background: one
         lane-prefill chunk program per configured bucket (at the bucket's
-        base attention window) plus the lane decode block, so the FIRST
-        admission under load finds them in the cache instead of paying a
-        synchronous compile stall on the serving path. No-op without AOT
-        blocks (DLLAMA_WINDOW_PRECOMPILE=0): the lazily jitted programs
-        then compile at first dispatch as before."""
+        base attention window) plus the lane decode block — and, when
+        speculation is on (spec_k > 0), one verify program per draft
+        bucket — so the FIRST admission under load finds them in the
+        cache instead of paying a synchronous compile stall on the
+        serving path. No-op without AOT blocks
+        (DLLAMA_WINDOW_PRECOMPILE=0): the lazily jitted programs then
+        compile at first dispatch as before."""
         self._require_lanes()
         if not self._aot_blocks:
             return
@@ -1066,6 +1070,21 @@ class InferenceEngine:
                     n, w, origin="prefetch"
                 ),
             )
+        if spec_k > 0:
+            # one verify program per draft bucket (width 1 + bucket for
+            # the pending token) at the base window; deeper windows ride
+            # the same 75% prefetch as the decode block
+            from .spec import spec_buckets
+
+            for kb in spec_buckets(min(spec_k, self._lane_pad - 1)):
+                t = kb + 1
+                window = self._attn_window(t)
+                self._prefetch(
+                    ("lane_verify", t, window),
+                    lambda tt=t, w=window: self._lane_verify_fn(
+                        tt, w, origin="prefetch"
+                    ),
+                )
         if self.kv_pool is not None:
             # page-copy programs sit on the admission (adopt) and finish
             # (publish) paths; pre-build every power-of-two bucket up to a
@@ -1665,6 +1684,178 @@ class InferenceEngine:
         )
         return [[int(t) for t in row] for row in out_np]
 
+    def _lane_verify_arg_specs(self, t: int):
+        """Arg specs for a speculative verify dispatch (the AOT
+        lowering input): token rows are (lanes, 1 + draft bucket) with
+        the lane sharding, plus the per-lane position vector and active
+        mask; params/cache trees come from the init-time snapshot (same
+        no-donated-reads rule as _lane_arg_specs)."""
+        b = self.batch_size
+        tok = jax.ShapeDtypeStruct(
+            (b, t), jnp.int32, sharding=self._token_sharding
+        )
+        return (
+            self._param_specs,
+            tok,
+            self._cache_specs,
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+            jax.ShapeDtypeStruct((b,), jnp.bool_),
+        )
+
+    def _lane_verify_fn(
+        self, t: int, window: int = 0, origin: str = "dispatch"
+    ):
+        """Batched draft verification for n-gram speculation
+        (runtime/spec.py): a close cousin of _lane_decode_fn that feeds
+        each ACTIVE lane a row of [pending token, draft_0..draft_{k-1},
+        pads] at vector positions pos..pos+t-1 in ONE forward pass and
+        returns the greedy argmax at EVERY position, so the host can
+        accept the longest draft prefix the model agrees with plus one
+        correction token. Unlike the decode block this is a single fwd
+        over t tokens, not t sequential fwds — one weight pass amortized
+        over up to t emitted tokens, which is the whole point on an
+        HBM-bound decode. Greedy only: sampled lanes take the normal
+        decode block in the same scheduler tick. AOT-compiled and
+        bucketed by draft length (spec_buckets) so no new shape compiles
+        mid-serve; rehearse_admission pre-builds every bucket."""
+        key = ("lane_verify", t, window)
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
+            ev = self._inflight.get(key) if origin == "dispatch" else None
+        if ev is not None:
+            ev.wait()
+            with self._compile_lock:
+                if key in self._compiled:
+                    return self._compiled[key]
+        precision = self._precision
+        fwd = self._fwd
+        park = self._park
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def vstep(params, tokens, cache, pos_vec, active):
+            cur = jnp.where(active, pos_vec, park)
+            ctx = (
+                jax.default_matmul_precision(precision)
+                if precision
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                logits, cache = fwd(
+                    params, tokens, cur, cache,
+                    attn_window=window, attn_park_threshold=park,
+                    logits_mode="all", n_micro=self._pp_micro(t),
+                )
+            out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out = jnp.where(active[:, None], out, 0)
+            return out, cache
+
+        self.recorder.record("compile_start", key=str(key), origin=origin)
+        t0 = time.perf_counter()
+        if self._aot_blocks:
+            vstep = vstep.lower(*self._lane_verify_arg_specs(t)).compile()
+        dt = time.perf_counter() - t0
+        with self._compile_lock:
+            self._compiled[key] = vstep
+            self._compile_origin[key] = origin
+            if self._aot_blocks:
+                self._compile_seconds[key] = dt
+        self._m_compiles.labels(origin=origin).inc()
+        self.recorder.record(
+            "compile_end", key=str(key), origin=origin, s=round(dt, 4)
+        )
+        return vstep
+
+    def verify_lanes(
+        self,
+        rows: list[list[int]],
+        pos: list[int],
+        active: list[bool],
+    ) -> list[list[int]]:
+        """Verify each active lane's draft row in ONE compiled dispatch.
+
+        `rows[l]` is [pending token, draft_0..draft_{k-1}, zero pads] of
+        the shared width t (a 1 + spec bucket); it is fed at positions
+        pos[l]..pos[l]+t-1 and the per-position greedy argmax grid
+        [lanes][t] comes back (inactive lanes report 0). The caller
+        accepts the longest draft prefix matching the argmax of the
+        PREVIOUS position plus one correction token and rewinds the
+        rest: rejected rows hold garbage KV, but they sit at-or-beyond
+        the lane's rewound position, so they are causally masked and
+        overwritten before any query can attend to them — the same
+        argument that covers block-decode rows past a stop, and why
+        publish-on-finish (which covers only history[:pos]) composes
+        with rewinds without touching the paged pool's accounting."""
+        self._require_lanes()
+        if len(rows) != self.batch_size or len(pos) != self.batch_size:
+            raise ValueError("rows/pos must have one entry per lane")
+        t = len(rows[0])
+        if t < 2:
+            raise ValueError("verify rows need a pending token + >=1 draft")
+        if any(len(r) != t for r in rows):
+            raise ValueError("verify rows must share one bucketed width")
+        if t > self._lane_pad:
+            raise ValueError(
+                f"verify width {t} exceeds lane padding {self._lane_pad} "
+                "(parked rows would clamp into live cache)"
+            )
+        live = [i for i, a in enumerate(active) if a]
+        if not live:
+            return []
+        for i in live:
+            if pos[i] + t > self.header.seq_len:
+                raise ValueError(
+                    f"lane {i}: verify row at pos {pos[i]} width {t} "
+                    f"exceeds seqLen {self.header.seq_len}"
+                )
+        deepest = max(pos[i] for i in live)
+        window = self._attn_window(deepest + t)
+        self._note_window(window)
+        vstep = self._lane_verify_fn(t, window)
+        if (
+            self._aot_blocks
+            and window < self.header.seq_len
+            and deepest + t >= (3 * window) // 4
+        ):
+            self._prefetch(
+                ("lane_verify", t, self._attn_window(window + 1)),
+                lambda nw=self._attn_window(window + 1): self._lane_verify_fn(
+                    t, nw, origin="prefetch"
+                ),
+            )
+        arr = jax.device_put(
+            jnp.asarray(rows, jnp.int32), self._token_sharding
+        )
+        pos_arr = jnp.asarray(pos, jnp.int32)
+        act_arr = jnp.asarray(active, jnp.bool_)
+        self.recorder.record(
+            "step_dispatch", step="verify_lanes", pos=deepest,
+            t=t, window=window, n_live=len(live),
+        )
+        sp = self._spans.begin(
+            "verify_lanes", component="engine", t=t,
+            pos=deepest, n_live=len(live), window=window,
+        )
+        t0 = time.perf_counter()
+        with self._cache_guard():
+            out, self.cache = vstep(
+                self.params, arr, self.cache, pos_arr, act_arr
+            )
+            sp_dev = self._spans.begin(
+                "verify_lanes.device", component="engine"
+            )
+            out_np = np.asarray(out)
+            self._spans.end(sp_dev)
+        dt = time.perf_counter() - t0
+        self._spans.end(sp)
+        self._m_step.labels(kind="verify_lanes").observe(dt)
+        self.recorder.record(
+            "step_complete", step="verify_lanes", pos=deepest,
+            t=t, window=window, n_live=len(live),
+            ms=round(dt * 1000, 3),
+        )
+        return [[int(x) for x in row] for row in out_np]
+
     def _bucket_for(self, n: int, pos: int) -> int:
         """Smallest bucket covering n tokens whose PADDED extent still fits
         in the cache (dynamic_update_slice clamps silently if pos+bucket >
@@ -1937,6 +2128,7 @@ class InferenceEngine:
                 "block": "decode_block",
                 "lane_block": "decode_lanes",
                 "lane_prefill": "prefill_lane",
+                "lane_verify": "verify_lanes",
                 "score": "score",
             }.get(key[0], key[0])
         return "prefill"  # plain (t, greedy, window) keys

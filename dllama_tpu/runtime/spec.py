@@ -1,0 +1,206 @@
+"""Model-free speculative drafting for the lane scheduler.
+
+Prompt-lookup speculation (Leviathan et al.'s accept-longest-prefix
+verification, with Saxena-style n-gram drafting instead of a draft
+model): each greedy lane keeps an n-gram index over its *own* context
+(prompt + generated tokens, extended incrementally as tokens stream)
+and, when the current suffix has appeared before, proposes the tokens
+that followed that earlier occurrence as a draft.  The engine then
+verifies the whole draft in ONE batched forward pass
+(``InferenceEngine.verify_lanes``) and the scheduler accepts the
+longest prefix whose greedy argmax matches, plus one correction token.
+
+Everything in this module is host-side and model-free: no draft
+network, no extra device memory, no new weights read.  The payoff is
+that an accepted run of ``a`` tokens amortizes one weight pass over
+``a + 1`` tokens — on an HBM-bound decode that is a direct tok/s
+multiplier for repetitive workloads (code, JSON extraction, quoting).
+
+Greedy output stays token-exact: only tokens the verify pass itself
+argmax'd are ever emitted, so the stream is byte-identical to plain
+greedy decoding (``tests/test_spec.py`` proves this with the same
+seeded parity harness used for chunked admission).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "DEFAULT_SPEC_K",
+    "NgramDrafter",
+    "NgramIndex",
+    "bucket_for",
+    "resolve_spec_knobs",
+    "spec_buckets",
+]
+
+DEFAULT_SPEC_K = 4
+DEFAULT_MAX_NGRAM = 3
+DEFAULT_COOLDOWN = 4
+
+
+def spec_buckets(k_max: int) -> Tuple[int, ...]:
+    """Draft-length buckets: powers of two up to ``k_max`` plus
+    ``k_max`` itself.
+
+    The engine AOT-compiles one verify program per bucket (token width
+    ``1 + bucket``) during ``rehearse_admission``, so no new shape ever
+    compiles mid-serve; the scheduler pads a draft up to the next
+    bucket.
+    """
+    if k_max < 1:
+        return ()
+    out: List[int] = []
+    b = 1
+    while b <= k_max:
+        out.append(b)
+        b *= 2
+    if out[-1] != k_max:
+        out.append(k_max)
+    return tuple(out)
+
+
+def bucket_for(k: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket that fits a draft of ``k`` tokens."""
+    for b in buckets:
+        if k <= b:
+            return b
+    return buckets[-1]
+
+
+def resolve_spec_knobs(
+    speculation: Optional[str] = None, spec_k: Optional[int] = None
+) -> Tuple[str, int]:
+    """Resolve the speculation knobs: explicit argument beats the
+    environment (``DLLAMA_SPECULATION``, ``DLLAMA_SPEC_K``) beats the
+    default (``"off"``, ``DEFAULT_SPEC_K``)."""
+    if speculation is None:
+        speculation = os.environ.get("DLLAMA_SPECULATION", "").strip() or "off"
+    if spec_k is None:
+        raw = os.environ.get("DLLAMA_SPEC_K", "").strip()
+        spec_k = int(raw) if raw else DEFAULT_SPEC_K
+    mode = str(speculation)
+    if mode not in ("off", "ngram"):
+        raise ValueError(f"speculation must be 'off' or 'ngram', got {mode!r}")
+    return mode, max(1, int(spec_k))
+
+
+class NgramIndex:
+    """Last-two-occurrence n-gram index over one lane's token stream.
+
+    For every n in [1, max_n] maps the n-gram ending at each position to
+    the *continuation start* of its latest and previous occurrences.
+    Two deep matters: the current suffix always matches its own entry
+    (whose continuation is empty), so lookups fall back to the previous
+    occurrence to find real continuation tokens.
+    """
+
+    def __init__(self, max_n: int = DEFAULT_MAX_NGRAM) -> None:
+        self.max_n = max(1, int(max_n))
+        self.tokens: List[int] = []
+        # per n: ngram -> (latest continuation start, previous or -1)
+        self._occ: List[Dict[Tuple[int, ...], Tuple[int, int]]] = [
+            {} for _ in range(self.max_n)
+        ]
+
+    def extend(self, tokens: Sequence[int]) -> None:
+        for raw in tokens:
+            self.tokens.append(int(raw))
+            i = len(self.tokens)
+            for n in range(1, self.max_n + 1):
+                if i < n:
+                    break
+                key = tuple(self.tokens[i - n : i])
+                d = self._occ[n - 1]
+                prev = d.get(key)
+                d[key] = (i, prev[0] if prev is not None else -1)
+
+    def lookup(self, k: int) -> List[int]:
+        """``k`` tokens predicted to follow the current suffix, read
+        from the most recent *earlier* occurrence of the longest
+        matching suffix n-gram ([] if the suffix has never been seen
+        before).
+
+        When the match sits close to the end of history — a stream in a
+        short cycle, where the previous occurrence is one period back —
+        the continuation is extended *cyclically*: once the copy runs
+        past the end of recorded history it keeps reading from the
+        draft itself, predicting that the period-``end - p`` repetition
+        continues.  Without this a period-1 stall would only ever yield
+        one draft token no matter how large ``k`` is.
+        """
+        toks = self.tokens
+        end = len(toks)
+        if end == 0 or k < 1:
+            return []
+        for n in range(min(self.max_n, end), 0, -1):
+            hit = self._occ[n - 1].get(tuple(toks[end - n : end]))
+            if hit is None:
+                continue
+            # hit[0] is the suffix's own (empty-continuation) entry;
+            # the previous occurrence is the usable one.
+            p = hit[1] if hit[0] >= end else hit[0]
+            if p < 0 or p >= end:
+                continue
+            out: List[int] = []
+            for j in range(k):
+                src = p + j
+                out.append(toks[src] if src < end else out[src - end])
+            return out
+        return []
+
+
+class NgramDrafter:
+    """Per-lane drafter: n-gram prompt lookup plus AIMD draft-length
+    adaptation.
+
+    ``update`` feeds the lane's history (only the unseen tail is
+    indexed), ``draft`` proposes up to the current adaptive ``k``
+    tokens, and ``feedback`` adapts after each verify: full acceptance
+    grows ``k`` additively, under-half acceptance halves it, and zero
+    acceptance additionally pauses drafting for a few ticks — the
+    context is clearly not in a repetitive stretch, so the lane rejoins
+    the plain decode block instead of wasting verify dispatches.
+    """
+
+    def __init__(
+        self,
+        k_max: int = DEFAULT_SPEC_K,
+        max_n: int = DEFAULT_MAX_NGRAM,
+        cooldown: int = DEFAULT_COOLDOWN,
+    ) -> None:
+        self.k_max = max(1, int(k_max))
+        self.k = self.k_max
+        self.index = NgramIndex(max_n)
+        self._cooldown_len = max(0, int(cooldown))
+        self._cooldown = 0
+        self.n_drafted = 0
+        self.n_accepted = 0
+
+    def update(self, history: Sequence[int]) -> None:
+        seen = len(self.index.tokens)
+        if len(history) > seen:
+            self.index.extend(history[seen:])
+
+    def draft(self, budget: Optional[int] = None) -> List[int]:
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return []
+        k = self.k if budget is None else min(self.k, budget)
+        if k < 1:
+            return []
+        return self.index.lookup(k)
+
+    def feedback(self, proposed: int, accepted: int) -> None:
+        self.n_drafted += proposed
+        self.n_accepted += accepted
+        if proposed <= 0:
+            return
+        if accepted >= proposed:
+            self.k = min(self.k_max, self.k + 1)
+        elif accepted * 2 < proposed:
+            self.k = max(1, self.k // 2)
+            if accepted == 0:
+                self._cooldown = self._cooldown_len
