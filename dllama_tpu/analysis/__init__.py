@@ -13,6 +13,8 @@ One entrypoint runs everything::
     python -m dllama_tpu.analysis            # lint the repo, exit 0/1
     python -m dllama_tpu.analysis --list-rules
     python -m dllama_tpu.analysis --update-baseline
+    python -m dllama_tpu.analysis --prune    # drop stale baseline entries
+    python -m dllama_tpu.analysis --hlo      # lint COMPILED programs
 
 Per-line suppressions use ``# dlint: disable=<rule>[,<rule>] — reason``
 on the offending line; pre-existing findings can instead live in the
@@ -43,6 +45,7 @@ def all_rules() -> list:
     rule modules import core, never the other way around)."""
     from .rules_clock import DirectClockRule
     from .rules_dashboard import DashboardStaticRule
+    from .rules_env import EnvKnobDocsRule
     from .rules_kv import RetainReleaseRule
     from .rules_locks import GuardedAttrsRule
     from .rules_metrics import MetricsDocsRule
@@ -57,4 +60,5 @@ def all_rules() -> list:
         ThreadHygieneRule(),
         MetricsDocsRule(),
         DashboardStaticRule(),
+        EnvKnobDocsRule(),
     ]

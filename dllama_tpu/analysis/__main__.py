@@ -1,5 +1,13 @@
 """``python -m dllama_tpu.analysis`` — run every dlint rule on the repo.
 
+``--hlo`` switches from source lint to compiled-program lint
+(:mod:`.xlalint`): it builds a tiny CPU engine, pre-compiles the
+admission program set, and checks every executable's HLO against the
+donation/collective/dtype/host/cost policies, gated by
+``xlalint-baseline.json``. ``--prune`` (in either mode) rewrites the
+baseline minus entries that no longer match any finding, so dead
+suppressions can't accumulate.
+
 Exit 0 when every finding is fixed, inline-suppressed, or baselined;
 exit 1 on any new finding (what CI's fast lane gates on); exit 2 on
 usage errors or unparseable sources.
@@ -20,6 +28,7 @@ from .core import (
     run_rules,
     write_baseline,
 )
+from .xlalint import XLALINT_BASELINE_NAME
 
 
 def repo_root() -> pathlib.Path:
@@ -54,8 +63,30 @@ def main(argv: list[str] | None = None) -> int:
         "--update-baseline", action="store_true",
         help="rewrite the baseline to the current findings and exit 0",
     )
+    ap.add_argument(
+        "--prune", action="store_true",
+        help="rewrite the baseline minus stale entries (ones matching no "
+             "current finding) and exit 0",
+    )
+    ap.add_argument(
+        "--hlo", action="store_true",
+        help="lint COMPILED programs (xlalint): build a tiny CPU engine, "
+             "precompile the admission program set, check HLO policies "
+             f"against <repo>/{XLALINT_BASELINE_NAME}",
+    )
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.hlo:
+        if args.list_rules:
+            from .xlalint import all_hlo_rules
+
+            for hr in all_hlo_rules():
+                print(f"{hr.name:24s} {hr.description}")
+            return 0
+        from .xlalint import run_hlo_cli
+
+        return run_hlo_cli(args)
 
     rules = all_rules()
     if args.list_rules:
@@ -95,6 +126,18 @@ def main(argv: list[str] | None = None) -> int:
     baseline = set() if args.no_baseline else load_baseline(baseline_path)
     new, baselined, stale = apply_baseline(findings, baseline)
 
+    if args.prune:
+        # keep exactly the entries that still match a finding: stale
+        # fingerprints (rule/file fixed or renamed) drop out, new
+        # findings are NOT added — pruning never widens the baseline
+        write_baseline(baseline_path, baselined)
+        print(
+            f"baseline pruned: {len(stale)} stale entr"
+            f"{'y' if len(stale) == 1 else 'ies'} removed, "
+            f"{len(baselined)} kept -> {baseline_path}"
+        )
+        return 0
+
     for f in new:
         print(f.render())
     if not args.quiet:
@@ -102,7 +145,7 @@ def main(argv: list[str] | None = None) -> int:
             print(
                 f"note: {len(stale)} stale baseline entr"
                 f"{'y' if len(stale) == 1 else 'ies'} no longer match any "
-                f"finding — prune with --update-baseline"
+                f"finding — prune with --prune"
             )
         print(
             f"dlint: {len(repo.modules)} files, {len(rules)} rules, "

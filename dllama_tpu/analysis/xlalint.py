@@ -1,0 +1,622 @@
+"""xlalint: static analysis over the COMPILED programs the engine runs.
+
+dlint (:mod:`.core` + the ``rules_*`` modules) checks the Python source;
+this module checks the artifact that actually executes on the
+accelerator — the post-GSPMD, post-optimization HLO of every AOT
+executable in the engine's compile cache (decode blocks, lane-prefill
+chunk programs, spec-verify buckets, ``kv_adopt``/``kv_publish`` copy
+programs), via ``Compiled.as_text()`` and ``cost_analysis()``. The
+invariants it enforces are exactly the ones the type system never sees:
+
+* **collective census** — only the collectives a program family is
+  allowed to lower (psums, the logits gather, ring permutes), and no
+  all-gather whose result reassembles a full weight/embed table on
+  every chip (the classic silent-regather perf cliff; the embed table
+  check used to live as a one-off regex test in
+  ``tests/test_parallel.py``);
+* **donation honored** — every donated buffer (the KV cache /
+  page-pool trees under ``donate_argnums``) must appear in the
+  executable's ``input_output_alias`` map; a dropped donation is
+  silent double-HBM;
+* **no host round-trips** — no host-callback ``custom-call``s,
+  infeed/outfeed, send/recv, or f64 in hot-path programs;
+* **dtype policy** — weight-path dots must not silently upcast to an
+  f32 accumulate-AND-STORE when the engine computes in bf16;
+* **cost budget** — per-program ``bytes_accessed``/``flops`` ceilings
+  derived from :func:`dllama_tpu.obs.cost.program_cost_ceilings`
+  roofline math.
+
+Three surfaces run it: ``python -m dllama_tpu.analysis --hlo`` (builds
+a tiny CPU engine, pre-compiles the admission program set, lints it
+against ``xlalint-baseline.json`` — the CI gate), the engine itself
+(every AOT compile is linted as it is built: warn-by-default,
+``DLLAMA_XLALINT=strict`` raises :class:`XlalintError`,
+``DLLAMA_XLALINT=0`` disables), and ``GET /v1/debug/xlalint`` on the
+API server. Baseline semantics are shared with dlint
+(``rule::path::message`` fingerprints, no line numbers); see
+docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pathlib
+import sys
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any, Iterable
+
+from .core import (
+    Finding,
+    apply_baseline,
+    load_baseline,
+)
+
+if TYPE_CHECKING:  # engine types only for annotations; jax stays lazy
+    from ..runtime.engine import InferenceEngine
+
+XLALINT_BASELINE_NAME = "xlalint-baseline.json"
+
+#: Collectives a sharded forward step may legitimately lower: psum
+#: (all-reduce), the vocab-sharded logits gather (all-gather),
+#: reduce-scatter from GSPMD rewrites, the sp ring / pp stage permutes,
+#: and all-to-all (XLA's distributed sort — the on-device top-p
+#: sampling path — lowers through it). ``collective-broadcast`` is NOT
+#: in the set: nothing in the forward should need it today.
+FORWARD_COLLECTIVES = frozenset(
+    {
+        "all-reduce",
+        "all-gather",
+        "reduce-scatter",
+        "collective-permute",
+        "all-to-all",
+    }
+)
+
+
+class XlalintError(RuntimeError):
+    """Raised (under ``DLLAMA_XLALINT=strict``) when a freshly compiled
+    program carries a new xlalint finding."""
+
+
+@dataclass(frozen=True)
+class FamilyPolicy:
+    """Declarative per-program-family policy the HLO rules check."""
+
+    #: collective op names allowed to appear (base names; async
+    #: ``-start``/``-done`` forms are normalized before the check)
+    allowed_collectives: frozenset = FORWARD_COLLECTIVES
+    #: largest legal all-gather RESULT, in elements (0 = unlimited).
+    max_allgather_elements: int = 0
+    #: trailing-two result dims that mean "a full weight/embed table
+    #: got reassembled" — e.g. {(vocab, dim), (dim, vocab)}
+    forbidden_gather_dims: frozenset = frozenset()
+    #: reject host-callback custom-calls / infeed / outfeed / send/recv
+    forbid_host: bool = True
+    #: reject any f64 tensor anywhere in the program
+    forbid_f64: bool = True
+    #: widest dtype a dot may STORE its result in, bits (0 = unlimited)
+    max_dot_store_bits: int = 32
+    #: flag bf16/f16 -> f32 convert feeding a dot that stores f32
+    #: (the silent accumulate-and-store upcast); off unless the engine
+    #: computes in a sub-f32 dtype
+    forbid_f32_upcast_store: bool = False
+
+
+@dataclass(frozen=True)
+class HloProgram:
+    """One compiled executable, as the rules see it."""
+
+    name: str  # compile-cache key, stringified
+    family: str  # engine step kind: decode_lanes, kv_adopt, ...
+    hlo_text: str
+    cost: dict | None  # {flops, bytes_accessed} or None
+    expected_aliases: int  # donated leaves that must alias (0 = none)
+    policy: FamilyPolicy
+    bytes_budget: float = 0.0  # 0 = no ceiling
+    flops_budget: float = 0.0
+
+    @property
+    def path(self) -> str:
+        """Pseudo-path findings anchor to (stable across runs)."""
+        return f"hlo://{self.family}/{self.name}"
+
+
+@dataclass(frozen=True)
+class HloFinding(Finding):
+    """A Finding with a free-form ``detail`` that is RENDERED but not
+    fingerprinted — raw cost numbers go here so the baseline stays
+    stable across backends while the report stays concrete."""
+
+    detail: str = ""
+
+    def render(self) -> str:
+        base = super().render()
+        return f"{base} [{self.detail}]" if self.detail else base
+
+
+class HloRule:
+    """Base class for compiled-program rules (see rules_hlo)."""
+
+    name = ""
+    description = ""
+
+    def check(self, prog: HloProgram) -> Iterable[Finding]:
+        return ()
+
+
+def all_hlo_rules() -> list:
+    """Every registered HLO rule, instantiated (lazy import so this
+    module stays importable without pulling the rule module first)."""
+    from .rules_hlo import (
+        CollectiveCensusRule,
+        CostBudgetRule,
+        DonationRule,
+        DtypePolicyRule,
+        HostRoundTripRule,
+    )
+
+    return [
+        CollectiveCensusRule(),
+        DonationRule(),
+        HostRoundTripRule(),
+        DtypePolicyRule(),
+        CostBudgetRule(),
+    ]
+
+
+def lint_programs(
+    programs: Iterable[HloProgram], rules: Iterable[HloRule] | None = None
+) -> list[Finding]:
+    findings: list[Finding] = []
+    rule_list = list(rules) if rules is not None else all_hlo_rules()
+    for prog in programs:
+        for rule in rule_list:
+            findings.extend(rule.check(prog))
+    findings.sort(key=lambda f: (f.path, f.rule, f.message))
+    return findings
+
+
+# -- engine integration -----------------------------------------------------
+
+def _tree_bytes(specs: Any) -> int:
+    import jax
+    import numpy as np
+
+    return sum(
+        int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(specs)
+    )
+
+
+def _tree_elems(specs: Any) -> int:
+    import jax
+    import numpy as np
+
+    return sum(
+        int(np.prod(leaf.shape)) for leaf in jax.tree.leaves(specs)
+    )
+
+
+def _tree_nleaves(specs: Any) -> int:
+    import jax
+
+    return len(jax.tree.leaves(specs))
+
+
+def _key_steps_tokens(key: Any, batch: int) -> tuple[int, int]:
+    """(loop steps, tokens per forward) of a compile-cache key — the
+    scale inputs to the cost budget. Plain ``(t, greedy, window)`` keys
+    are prefill chunks; tagged keys carry their width at index 1."""
+    if isinstance(key, tuple) and key and isinstance(key[0], str):
+        kind = key[0]
+        n = int(key[1]) if len(key) > 1 else 1
+        if kind in ("block", "lane_block"):
+            return n, batch
+        # lane_prefill / lane_verify / score / kv_*: one forward, n wide
+        return 1, n * batch
+    if isinstance(key, tuple) and key:
+        return 1, int(key[0]) * batch
+    return 1, batch
+
+
+def engine_policies(engine: "InferenceEngine") -> dict:
+    """Per-family policies for THIS engine: forbidden regather shapes
+    from the model header (only meaningful when weights are actually
+    sharded, tp > 1), the logits gather as the biggest legal all-gather,
+    and the bf16 upcast check only when the engine computes in bf16."""
+    import jax.numpy as jnp
+
+    h = engine.header
+    sharded = engine.tp > 1
+    tables = frozenset(
+        d
+        for a, b in (
+            (h.vocab_size, h.dim),
+            (h.q_dim, h.dim),
+            (h.kv_dim, h.dim),
+            (h.ff_dim, h.dim),
+        )
+        for d in ((a, b), (b, a))
+    ) if sharded else frozenset()
+    max_ag = (
+        4 * engine.batch_size * max(engine.prefill_buckets) * h.vocab_size
+        if sharded
+        else 0
+    )
+    bf16 = engine.dtype == jnp.bfloat16
+    fwd = FamilyPolicy(
+        forbidden_gather_dims=tables,
+        max_allgather_elements=max_ag,
+        forbid_f32_upcast_store=bf16,
+    )
+    copy = FamilyPolicy(
+        allowed_collectives=frozenset(),  # pure shard-local copies
+        forbid_f32_upcast_store=False,
+    )
+    return {
+        "prefill": fwd,
+        "decode_block": fwd,
+        "decode_lanes": fwd,
+        "prefill_lane": fwd,
+        "verify_lanes": fwd,
+        "score": fwd,
+        "kv_adopt": copy,
+        "kv_publish": copy,
+    }
+
+
+def _engine_program(
+    engine: "InferenceEngine", key: Any, fn: Any, policies: dict
+) -> HloProgram | None:
+    """Build the HloProgram for one compile-cache entry, or None when
+    the entry exposes no executable (lazily jitted programs)."""
+    from ..obs.cost import extract_cost, program_cost_ceilings
+
+    as_text = getattr(fn, "as_text", None)
+    if not callable(as_text):
+        return None
+    try:
+        txt = as_text()
+    except Exception:
+        return None
+    if not isinstance(txt, str) or not txt:
+        return None
+    family = engine._key_kind(key)
+    policy = policies.get(family, FamilyPolicy())
+    cache_b = _tree_bytes(engine._cache_specs)
+    pool_b = (
+        _tree_bytes(engine._kv_pool_specs)
+        if engine._kv_pool_specs is not None
+        else 0
+    )
+    steps, tokens = _key_steps_tokens(key, engine.batch_size)
+    ceilings = program_cost_ceilings(
+        family,
+        steps=steps,
+        tokens=tokens,
+        param_bytes=_tree_bytes(engine._param_specs),
+        cache_bytes=cache_b,
+        pool_bytes=pool_b,
+        param_elems=_tree_elems(engine._param_specs),
+        cache_elems=_tree_elems(engine._cache_specs),
+    )
+    if family == "kv_publish":
+        expected = (
+            _tree_nleaves(engine._kv_pool_specs)
+            if engine._kv_pool_specs is not None
+            else 0
+        )
+    else:
+        expected = _tree_nleaves(engine._cache_specs)
+    return HloProgram(
+        name=str(key),
+        family=family,
+        hlo_text=txt,
+        cost=extract_cost(fn),
+        expected_aliases=expected,
+        policy=policy,
+        bytes_budget=ceilings["bytes_accessed"],
+        flops_budget=ceilings["flops"],
+    )
+
+
+def engine_programs(
+    engine: "InferenceEngine",
+) -> tuple[list[HloProgram], list[str]]:
+    """(lintable programs, skipped keys) from the engine's compile
+    cache. Lazily jitted entries (plain prefill/score steps under
+    ``DLLAMA_WINDOW_PRECOMPILE=0``, or never-called jits) expose no
+    executable and are reported as skipped, never silently dropped."""
+    with engine._compile_lock:
+        items = list(engine._compiled.items())
+    policies = engine_policies(engine)
+    programs: list[HloProgram] = []
+    skipped: list[str] = []
+    for key, fn in items:
+        prog = _engine_program(engine, key, fn, policies)
+        if prog is None:
+            skipped.append(str(key))
+        else:
+            programs.append(prog)
+    return programs, skipped
+
+
+def repo_root() -> pathlib.Path:
+    # analysis/ -> dllama_tpu/ -> repo root (same rule as __main__)
+    return pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def default_baseline_path() -> pathlib.Path:
+    return repo_root() / XLALINT_BASELINE_NAME
+
+
+def lint_engine_report(
+    engine: "InferenceEngine", baseline: set | None = None
+) -> dict:
+    """The ``engine.xlalint_report()`` / ``GET /v1/debug/xlalint``
+    payload: every finding split new-vs-baselined, plus the per-program
+    census so an operator can see what was checked (and what was
+    skipped for having no executable)."""
+    if baseline is None:
+        baseline = load_baseline(default_baseline_path())
+    programs, skipped = engine_programs(engine)
+    findings = lint_programs(programs)
+    new, baselined, stale = apply_baseline(findings, baseline)
+    return {
+        "n_programs": len(programs),
+        "skipped": skipped,
+        "new_findings": [f.render() for f in new],
+        "baselined_findings": [f.render() for f in baselined],
+        "stale_baseline_entries": sorted(stale),
+        "programs": [
+            {
+                "name": p.name,
+                "family": p.family,
+                "cost": p.cost,
+                "expected_aliases": p.expected_aliases,
+                "bytes_budget": p.bytes_budget,
+                "flops_budget": p.flops_budget,
+            }
+            for p in programs
+        ],
+    }
+
+
+def lint_engine_key(
+    engine: "InferenceEngine", key: Any, baseline: set | None = None
+) -> list[Finding]:
+    """New (non-baselined) findings for ONE just-compiled program — the
+    per-compile hook the engine calls after every AOT build."""
+    if baseline is None:
+        baseline = load_baseline(default_baseline_path())
+    with engine._compile_lock:
+        fn = engine._compiled.get(key)
+    if fn is None:
+        return []
+    prog = _engine_program(engine, key, fn, engine_policies(engine))
+    if prog is None:
+        return []
+    new, _, _ = apply_baseline(lint_programs([prog]), baseline)
+    return new
+
+
+# -- CLI (--hlo mode) -------------------------------------------------------
+
+_TINY_CFG = dict(
+    dim=64,
+    hidden_dim=160,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=16,
+    vocab_size=256,
+    seq_len=64,
+)
+
+
+def _write_tiny_model(path: str, seed: int = 0) -> None:
+    """A tiny random F32 `.m` model for the CLI's self-contained engine
+    (mirrors tests/helpers.make_tiny_model, which must stay test-only)."""
+    import numpy as np
+
+    from ..formats import FloatType
+    from ..formats.model_file import LlmArch
+    from ..formats.writer import write_header, write_tensor
+
+    cfg = _TINY_CFG
+    rng = np.random.default_rng(seed)
+    d, hd = cfg["dim"], cfg["head_dim"]
+    q_dim = hd * cfg["n_heads"]
+    kv_dim = hd * cfg["n_kv_heads"]
+    ff = cfg["hidden_dim"]
+
+    def t(*shape: int) -> Any:
+        return (rng.standard_normal(shape) * 0.08).astype(np.float32)
+
+    header = {
+        "version": 0,
+        "arch_type": int(LlmArch.LLAMA),
+        "dim": d,
+        "hidden_dim": ff,
+        "n_layers": cfg["n_layers"],
+        "n_heads": cfg["n_heads"],
+        "n_kv_heads": cfg["n_kv_heads"],
+        "n_experts": 0,
+        "n_active_experts": 0,
+        "vocab_size": cfg["vocab_size"],
+        "max_seq_len": cfg["seq_len"],
+        "hidden_act": 1,
+        "rope_theta": 10000,
+        "weights_float_type": int(FloatType.F32),
+        "head_dim": hd,
+        "norm_epsilon": 5,
+    }
+    with open(path, "wb") as f:
+        write_header(f, header)
+        write_tensor(f, t(cfg["vocab_size"], d), FloatType.F32)
+        for _ in range(cfg["n_layers"]):
+            write_tensor(f, t(q_dim, d), FloatType.F32)
+            write_tensor(f, t(kv_dim, d), FloatType.F32)
+            write_tensor(f, t(kv_dim, d), FloatType.F32)
+            write_tensor(f, t(d, q_dim), FloatType.F32)
+            write_tensor(f, t(ff, d), FloatType.F32)
+            write_tensor(f, t(d, ff), FloatType.F32)
+            write_tensor(f, t(ff, d), FloatType.F32)
+            write_tensor(f, 1.0 + t(d), FloatType.F32)
+            write_tensor(f, 1.0 + t(d), FloatType.F32)
+        write_tensor(f, 1.0 + t(d), FloatType.F32)
+        write_tensor(f, t(cfg["vocab_size"], d), FloatType.F32)
+
+
+def _ensure_virtual_devices(n: int = 2) -> None:
+    """Ask for n virtual CPU devices so the CLI engine can run tp > 1
+    (collective census with real all-gathers). Only effective when jax
+    is not imported yet and the flag is not already set."""
+    if "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+def build_cli_engine() -> "InferenceEngine":
+    """The tiny self-contained engine the ``--hlo`` CLI lints: tp=2 when
+    two devices are available (so the census sees the real psums and
+    the logits gather), lanes + a KV pool + speculation buckets so every
+    AOT program family is present, and every admission program compiled
+    synchronously before returning."""
+    # no double-reporting: the CLI prints findings itself, so the
+    # per-compile warn hook stays off while this engine builds
+    os.environ.setdefault("DLLAMA_XLALINT", "0")
+    _ensure_virtual_devices(2)
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..runtime.engine import InferenceEngine
+
+    tp = 2 if len(jax.devices()) >= 2 else 1
+    d = tempfile.mkdtemp(prefix="xlalint-")
+    mp = os.path.join(d, "tiny.m")
+    _write_tiny_model(mp)
+    engine = InferenceEngine(
+        mp,
+        tp=tp,
+        dtype=jnp.float32,
+        temperature=0.0,
+        batch_size=4,
+        prefill_buckets=(1, 8, 32),
+    )
+    engine.init_kv_pool(page_size=8)
+    engine.rehearse_admission(block_size=8, spec_k=2, wait=True)
+    return engine
+
+
+def run_hlo_cli(args: Any) -> int:
+    """``python -m dllama_tpu.analysis --hlo``: build the tiny engine,
+    lint every compiled program, apply/maintain the xlalint baseline.
+    Exit codes match dlint: 0 clean, 1 new findings."""
+    engine = build_cli_engine()
+    programs, skipped = engine_programs(engine)
+    findings = lint_programs(programs)
+
+    baseline_path = (
+        pathlib.Path(args.baseline)
+        if args.baseline
+        else default_baseline_path()
+    )
+    if args.update_baseline:
+        write_baseline_fingerprints(
+            baseline_path, (f.fingerprint() for f in findings)
+        )
+        print(
+            f"xlalint baseline written: {len(findings)} finding(s) -> "
+            f"{baseline_path}"
+        )
+        return 0
+
+    baseline = set() if args.no_baseline else load_baseline(baseline_path)
+    new, baselined, stale = apply_baseline(findings, baseline)
+
+    if args.prune:
+        kept = baseline - stale
+        write_baseline_fingerprints(baseline_path, kept)
+        print(
+            f"xlalint baseline pruned: {len(stale)} stale entr"
+            f"{'y' if len(stale) == 1 else 'ies'} removed, "
+            f"{len(kept)} kept -> {baseline_path}"
+        )
+        return 0
+
+    for f in new:
+        print(f.render())
+    if not args.quiet:
+        if stale:
+            print(
+                f"note: {len(stale)} stale xlalint baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} no longer match any "
+                f"finding — prune with --hlo --prune"
+            )
+        n_rules = len(all_hlo_rules())
+        print(
+            f"xlalint: {len(programs)} compiled programs ({len(skipped)} "
+            f"skipped: no executable), {n_rules} rules, {len(new)} new "
+            f"finding(s), {len(baselined)} baselined"
+        )
+    return 1 if new else 0
+
+
+def write_baseline_fingerprints(
+    path: pathlib.Path, fingerprints: Iterable[str]
+) -> None:
+    """Rewrite a baseline file from raw fingerprints (the --prune path,
+    where stale entries have no live Finding to round-trip through)."""
+    import json
+
+    data = {
+        "comment": (
+            "xlalint baseline: fingerprints of pre-existing compiled-"
+            "program findings allowed to persist. Regenerate with "
+            "`python -m dllama_tpu.analysis --hlo --update-baseline`; "
+            "prune stale entries with `--hlo --prune`."
+        ),
+        "findings": sorted(set(fingerprints)),
+    }
+    path.write_text(json.dumps(data, indent=2) + "\n")
+
+
+def make_program(
+    hlo_text: str,
+    *,
+    name: str = "toy",
+    family: str = "decode_lanes",
+    policy: FamilyPolicy | None = None,
+    cost: dict | None = None,
+    expected_aliases: int = 0,
+    bytes_budget: float = 0.0,
+    flops_budget: float = 0.0,
+) -> HloProgram:
+    """Convenience constructor for tests and ad-hoc linting of a single
+    HLO dump (seeded-violation fixtures build programs through this)."""
+    return HloProgram(
+        name=name,
+        family=family,
+        hlo_text=hlo_text,
+        cost=cost,
+        expected_aliases=expected_aliases,
+        policy=policy if policy is not None else FamilyPolicy(),
+        bytes_budget=bytes_budget,
+        flops_budget=flops_budget,
+    )
+
+
+def replace_policy(prog: HloProgram, **changes: Any) -> HloProgram:
+    """A program with its policy fields replaced (tests tighten one
+    knob at a time)."""
+    return dataclasses.replace(
+        prog, policy=dataclasses.replace(prog.policy, **changes)
+    )

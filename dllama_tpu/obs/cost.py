@@ -121,6 +121,53 @@ def weight_bytes_per_token(
     return int(total)
 
 
+def program_cost_ceilings(
+    family: str,
+    *,
+    steps: int = 1,
+    tokens: int = 1,
+    param_bytes: float = 0.0,
+    cache_bytes: float = 0.0,
+    pool_bytes: float = 0.0,
+    param_elems: float = 0.0,
+    cache_elems: float = 0.0,
+    slack: float = 8.0,
+) -> dict:
+    """Per-program {bytes_accessed, flops} ceilings for the xlalint cost
+    budget gate, derived from the same roofline model as
+    ``weight_bytes_per_token``: a forward step fundamentally reads the
+    weights once plus the touched KV window (bytes floor) and does
+    ~2 flops per weight per token plus the attention reads (flops
+    floor). The ``slack`` multiple (default 8x) makes these CLIFF
+    guards, not tight bounds — a program only trips one when it does
+    work a whole multiple of its analytic floor (the classic regather /
+    accidental-replication failure mode), so backend fusion differences
+    never flap the gate. Copy programs (``kv_adopt``/``kv_publish``)
+    move pages between the lane slab and the pool: their bytes ceiling
+    is a slack multiple of the two buffers and their flops are ~0 (a
+    flat allowance covers index arithmetic).
+    """
+    if family in ("kv_adopt", "kv_publish"):
+        return {
+            "bytes_accessed": slack * (cache_bytes + pool_bytes),
+            "flops": slack * cache_elems + 1e6,
+        }
+    steps = max(1, steps)
+    tokens = max(1, tokens)
+    # the cache term scales with the token count: a t-wide prefill's
+    # attention reads/writes the KV window per token, and on small
+    # models that activation traffic dwarfs the one-time weight read
+    base_bytes = param_bytes + (1.0 + tokens) * cache_bytes + pool_bytes
+    return {
+        "bytes_accessed": slack * steps * base_bytes,
+        "flops": (
+            slack * steps * (2.0 * param_elems * tokens
+                             + 4.0 * cache_elems * tokens)
+            + 1e6
+        ),
+    }
+
+
 def roofline_report(
     h: "LlmHeader", weight_format: str, tp: int = 1, pp: int = 1,
     i8_group: int = 512

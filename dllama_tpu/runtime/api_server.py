@@ -21,6 +21,8 @@ Capability port of the reference's `dllama-api` (src/dllama-api.cpp):
   (obs/slo.py);
 * ``GET /v1/debug/series`` — in-process metrics time-series
   (obs/timeseries.py; ``?name=&window=`` for points, bare for the index);
+* ``GET /v1/debug/xlalint`` — compiled-program lint over the live
+  compile cache (analysis/xlalint.py; docs/static_analysis.md);
 * ``GET /dashboard`` — zero-dependency live dashboard, a single
   self-contained HTML page of canvas sparklines (obs/dashboard.py);
 * ``POST /v1/debug/profile`` — on-demand ``jax.profiler`` capture
@@ -1473,6 +1475,7 @@ _KNOWN_PATHS = frozenset(
         "/v1/debug/recorder",
         "/v1/debug/memory",
         "/v1/debug/compile",
+        "/v1/debug/xlalint",
         "/v1/debug/kv",
         "/v1/debug/timeline",
         "/v1/debug/slo",
@@ -1636,6 +1639,11 @@ def make_handler(state: ApiState):
                         "cost": state.engine.cost_report(),
                     }
                 )
+            elif path == "/v1/debug/xlalint":
+                # compiled-program lint over the live compile cache:
+                # donation/collective/dtype/host/cost-budget findings
+                # split new-vs-baselined (docs/static_analysis.md)
+                self._json(state.engine.xlalint_report())
             elif path == "/v1/debug/timeline":
                 # Chrome-trace / Perfetto JSON of the span ring; with
                 # ?request_id= it narrows to one request and adds its

@@ -208,6 +208,12 @@ class InferenceEngine:
             "will stall on a synchronous compile).",
             labelnames=("origin",),
         )
+        self._m_xlalint = self.obs.counter(
+            "dllama_xlalint_findings_total",
+            "New (non-baselined) xlalint findings on freshly compiled "
+            "programs; any increment means a compiled executable broke "
+            "a donation/collective/dtype/host/cost-budget invariant.",
+        )
         self._m_window_crossings = self.obs.counter(
             "dllama_engine_window_crossings_total",
             "Attention-window boundary crossings (a larger compiled "
@@ -421,6 +427,17 @@ class InferenceEngine:
         # only after its first call.
         self._cost_cache: dict = {}
         self.obs.add_refresh_hook("engine.cost", self.cost_report)
+        # compiled-program lint (xlalint, docs/static_analysis.md): every
+        # AOT build is checked right after it lands in the cache —
+        # donation honored, collective census, dtype/host policy, cost
+        # budget. "0"/"off" disables, "strict" raises XlalintError on a
+        # new finding (dispatch-path compiles propagate it; prefetch
+        # threads log it and mark the key prefetch-failed), anything
+        # else warns through the engine logger.
+        self._xlalint_mode = (
+            _os.environ.get("DLLAMA_XLALINT", "warn").strip().lower()
+        )
+        self._xlalint_baseline: set | None = None
 
         if moe_decode_dedup == "auto":
             # decision boundary from the routing-correlation study
@@ -722,6 +739,7 @@ class InferenceEngine:
         self.recorder.record(
             "compile_end", key=str(key), origin=origin, s=round(dt, 4)
         )
+        self._xlalint_after_compile(key)
         return block
 
     def _prefetch(self, key, builder) -> None:
@@ -1037,10 +1055,14 @@ class InferenceEngine:
         self.recorder.record(
             "compile_end", key=str(key), origin=origin, s=round(dt, 4)
         )
+        self._xlalint_after_compile(key)
         return step
 
     def rehearse_admission(
-        self, block_size: int | None = None, spec_k: int = 0
+        self,
+        block_size: int | None = None,
+        spec_k: int = 0,
+        wait: bool = False,
     ) -> None:
         """Pre-compile the admission-path programs in the background: one
         lane-prefill chunk program per configured bucket (at the bucket's
@@ -1050,7 +1072,11 @@ class InferenceEngine:
         cache instead of paying a synchronous compile stall on the
         serving path. No-op without AOT blocks
         (DLLAMA_WINDOW_PRECOMPILE=0): the lazily jitted programs then
-        compile at first dispatch as before."""
+        compile at first dispatch as before.
+
+        ``wait=True`` blocks until every scheduled compile has finished
+        (successfully or not) — what the xlalint CLI and the clean-engine
+        smoke test use to lint a deterministic program set."""
         self._require_lanes()
         if not self._aot_blocks:
             return
@@ -1100,6 +1126,17 @@ class InferenceEngine:
                         ),
                     )
                 b *= 2
+        if wait:
+            # drain the prefetch threads: snapshot under the lock, wait
+            # outside it (builders need the lock to finish), repeat until
+            # nothing is in flight
+            while True:
+                with self._compile_lock:
+                    pending = list(self._inflight.values())
+                if not pending:
+                    return
+                for ev in pending:
+                    ev.wait()
 
     def prefill_lane_chunk(
         self,
@@ -1370,6 +1407,7 @@ class InferenceEngine:
         self.recorder.record(
             "compile_end", key=str(key), origin=origin, s=round(dt, 4)
         )
+        self._xlalint_after_compile(key)
         return fn
 
     def _kv_copy_chunks(self, n: int):
@@ -1571,6 +1609,7 @@ class InferenceEngine:
         self.recorder.record(
             "compile_end", key=str(key), origin=origin, s=round(dt, 4)
         )
+        self._xlalint_after_compile(key)
         return block
 
     def decode_lanes(
@@ -1764,6 +1803,7 @@ class InferenceEngine:
         self.recorder.record(
             "compile_end", key=str(key), origin=origin, s=round(dt, 4)
         )
+        self._xlalint_after_compile(key)
         return vstep
 
     def verify_lanes(
@@ -2219,4 +2259,60 @@ class InferenceEngine:
             if frac is not None:
                 g_roof.labels(kind=kind).set(frac)
         return {"hbm_peak_bytes_per_s": peak, "kinds": per_kind}
+
+    def _xlalint_baseline_set(self) -> set:
+        if self._xlalint_baseline is None:
+            from ..analysis.core import load_baseline
+            from ..analysis.xlalint import default_baseline_path
+
+            self._xlalint_baseline = load_baseline(default_baseline_path())
+        return self._xlalint_baseline
+
+    def _xlalint_after_compile(self, key) -> None:
+        """Lint ONE just-compiled program (called at the end of every
+        builder fn, so dispatch compiles, window prefetches, and
+        rehearse_admission all pass through). Warn-by-default;
+        DLLAMA_XLALINT=strict raises XlalintError, =0/off disables.
+        Lint bugs themselves must never take down a serving compile, so
+        non-strict mode swallows analysis errors after logging them."""
+        if self._xlalint_mode in ("0", "off", "false"):
+            return
+        if not self._aot_blocks:
+            return  # lazily jitted: no executable to read yet
+        import logging
+
+        from ..analysis.xlalint import XlalintError, lint_engine_key
+
+        try:
+            new = lint_engine_key(self, key, self._xlalint_baseline_set())
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "xlalint failed analyzing %r (program NOT checked)", key
+            )
+            return
+        if not new:
+            return
+        rendered = "; ".join(f.render() for f in new)
+        self._m_xlalint.inc(len(new))
+        if self._xlalint_mode == "strict":
+            raise XlalintError(
+                f"xlalint: {len(new)} new finding(s) in compiled program "
+                f"{key!r}: {rendered}"
+            )
+        logging.getLogger(__name__).warning(
+            "xlalint: %d new finding(s) in compiled program %r: %s",
+            len(new), key, rendered,
+        )
+
+    def xlalint_report(self) -> dict:
+        """Compiled-program lint over the WHOLE compile cache (what
+        `GET /v1/debug/xlalint` serves): per-program census, findings
+        split new-vs-baselined against xlalint-baseline.json, and the
+        keys skipped for exposing no executable. See
+        docs/static_analysis.md."""
+        from ..analysis.xlalint import lint_engine_report
+
+        rep = lint_engine_report(self, self._xlalint_baseline_set())
+        rep["mode"] = self._xlalint_mode
+        return rep
 

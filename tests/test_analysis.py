@@ -20,6 +20,7 @@ from dllama_tpu.analysis.core import (
     write_baseline,
 )
 from dllama_tpu.analysis.rules_clock import DirectClockRule
+from dllama_tpu.analysis.rules_env import EnvKnobDocsRule
 from dllama_tpu.analysis.rules_kv import RetainReleaseRule
 from dllama_tpu.analysis.rules_locks import GuardedAttrsRule
 from dllama_tpu.analysis.rules_metrics import MetricsDocsRule
@@ -110,6 +111,74 @@ def test_metrics_docs_rule_both_directions(tmp_path):
     assert "dllama_undocumented_thing" in msgs
     assert "dllama_phantom_metric" in msgs
     assert "dllama_documented_total" not in msgs
+
+
+@pytest.mark.fast
+def test_env_knob_docs_rule_both_directions(tmp_path):
+    (tmp_path / "dllama_tpu").mkdir()
+    (tmp_path / "dllama_tpu" / "m.py").write_text(
+        'a = os.environ.get("DLLAMA_DOCUMENTED_KNOB", "0")\n'
+        'b = _env_int(\n    "DLLAMA_UNDOCUMENTED_KNOB", 4)\n'
+        'c = os.getenv("DLLAMA_FAM_MEMBER")\n'
+        '# a comment naming DLLAMA_ONLY_IN_COMMENT is not a read site\n'
+        'os.environ.setdefault("DLLAMA_SETDEFAULT_ONLY", "1")\n'
+    )
+    (tmp_path / "README.md").write_text(
+        "Set `DLLAMA_DOCUMENTED_KNOB` to tune things.\n"
+        "`DLLAMA_PHANTOM_KNOB` — documented, read nowhere.\n"
+        "The `DLLAMA_FAM_*` family covers its members.\n"
+        "The `DLLAMA_GHOSTFAM_*` family matches no read at all.\n"
+    )
+    repo = collect_repo(tmp_path, ["dllama_tpu"])
+    findings, _ = run_rules(repo, [EnvKnobDocsRule()])
+    msgs = " ".join(f.message for f in findings)
+    assert "DLLAMA_UNDOCUMENTED_KNOB is read here but documented" in msgs
+    assert "DLLAMA_PHANTOM_KNOB is documented but read nowhere" in msgs
+    assert "family DLLAMA_GHOSTFAM_* is documented but no knob" in msgs
+    # documented+read, wildcard-covered, setdefault and comments: quiet
+    for quiet in (
+        "DLLAMA_DOCUMENTED_KNOB is read",
+        "DLLAMA_FAM_MEMBER",
+        "DLLAMA_SETDEFAULT_ONLY",
+        "DLLAMA_ONLY_IN_COMMENT",
+    ):
+        assert quiet not in msgs, msgs
+    assert len(findings) == 3
+
+
+@pytest.mark.fast
+def test_cli_prune_drops_stale_baseline_entries(tmp_path):
+    bad = f"{FIXDIR}/bad_guarded_attrs.py"
+    bp = tmp_path / "baseline.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "dllama_tpu.analysis",
+         "--update-baseline", "--baseline", str(bp), bad],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    real = json.loads(bp.read_text())["findings"]
+    assert real
+    # graft a stale fingerprint in, then prune: only the ghost goes away
+    doc = json.loads(bp.read_text())
+    doc["findings"] = sorted(real + ["ghost-rule::gone.py::never"])
+    bp.write_text(json.dumps(doc))
+    proc = subprocess.run(
+        [sys.executable, "-m", "dllama_tpu.analysis",
+         "--prune", "--baseline", str(bp), bad],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(bp.read_text())["findings"] == sorted(real)
+    # pruning never widens: findings NOT yet in the baseline stay out
+    doc = json.loads(bp.read_text())
+    doc["findings"] = doc["findings"][:1]
+    bp.write_text(json.dumps(doc))
+    subprocess.run(
+        [sys.executable, "-m", "dllama_tpu.analysis",
+         "--prune", "--baseline", str(bp), bad],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+    )
+    assert json.loads(bp.read_text())["findings"] == sorted(real)[:1]
 
 
 @pytest.mark.fast

@@ -1,0 +1,376 @@
+"""xlalint self-tests (PR 11): each compiled-program rule fires on a
+deliberately broken executable and stays quiet on the healthy one.
+
+Two layers:
+
+* parser/rule units against a synthetic HLO dump (no compilation) and
+  against REAL CPU-compiled toy programs seeded with one violation
+  each — a dropped donation, a full-table all-gather, a host callback,
+  an f32 accumulate-and-store upcast, a blown cost budget;
+* a clean-engine smoke: a tiny real engine pre-compiles its admission
+  program set (``rehearse_admission(wait=True)``) and
+  ``xlalint_report()`` must show zero new findings — the same gate
+  ``python -m dllama_tpu.analysis --hlo`` runs in CI — plus strict-mode
+  raise behavior through the engine's own per-compile hook.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dllama_tpu.analysis.core import apply_baseline, load_baseline
+from dllama_tpu.analysis.rules_hlo import (
+    CollectiveCensusRule,
+    CostBudgetRule,
+    DonationRule,
+    DtypePolicyRule,
+    HostRoundTripRule,
+    collective_census,
+    custom_call_targets,
+    dot_store_dtypes,
+    f32_upcast_store_dots,
+    forbidden_gather_findings,
+    gather_result_shapes,
+    input_output_alias_count,
+    scatter_result_dims,
+)
+from dllama_tpu.analysis.xlalint import (
+    FamilyPolicy,
+    HloFinding,
+    all_hlo_rules,
+    lint_programs,
+    make_program,
+    write_baseline_fingerprints,
+)
+
+from helpers import make_tiny_model
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _compile(fn, *args, donate=()):
+    return jax.jit(fn, donate_argnums=donate).lower(*args).compile()
+
+
+def _findings(txt, rule, **prog_kw):
+    return lint_programs([make_program(txt, **prog_kw)], [rule])
+
+
+# -- parsers on a synthetic dump (no compilation) ---------------------------
+
+SYNTHETIC = """\
+HloModule jit_step, input_output_alias={ {0}: (2, {}, may-alias), {1}: (3, {}, may-alias) }, entry_computation_layout={(f32[4,64])->f32[4,64]}
+
+ENTRY %main.42 (p0: f32[4,64], p1: bf16[64,64]) -> f32[4,64] {
+  %p0 = f32[4,64]{1,0} parameter(0)
+  %p1 = bf16[64,64]{1,0} parameter(1)
+  %convert.1 = f32[64,64]{1,0} convert(bf16[64,64]{1,0} %p1)
+  %dot.2 = f32[4,64]{1,0} dot(f32[4,64]{1,0} %p0, f32[64,64]{1,0} %convert.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %all-gather.3 = f32[256,64]{1,0} all-gather(f32[128,64]{1,0} %p0), replica_groups={{0,1}}, dimensions={0}, metadata={op_name="all-gather decoy in a string"}
+  %all-reduce.4 = f32[4,64]{1,0} all-reduce(f32[4,64]{1,0} %dot.2), to_apply=%region_0.7
+  %custom-call.5 = f32[4]{0} custom-call(f32[4,64]{1,0} %p0), custom_call_target="xla_python_cpu_callback"
+  %custom-call.6 = f32[4]{0} custom-call(f32[4,64]{1,0} %p0), custom_call_target="tpu_custom_call"
+  %constant.7 = f64[] constant(1)
+  %scatter.8 = f32[2,1024,16]{2,1,0} scatter(f32[2,1024,16]{2,1,0} %p0, s32[16,1]{1,0} %p0, f32[2,16,16]{2,1,0} %p0), to_apply=%region_1.9
+  %all-gather-start.9 = (f32[128,64]{1,0}, f32[256,64]{1,0}) all-gather-start(f32[128,64]{1,0} %p0), dimensions={0}
+  %all-gather-done.10 = f32[256,64]{1,0} all-gather-done(%all-gather-start.9)
+}
+"""
+
+
+@pytest.mark.fast
+def test_synthetic_parsers():
+    # async pair counts ONCE; the metadata decoy string never matches
+    assert collective_census(SYNTHETIC) == {"all-gather": 2, "all-reduce": 1}
+    shapes = gather_result_shapes(SYNTHETIC)
+    assert ("f32", (256, 64)) in shapes and len(shapes) == 2
+    assert input_output_alias_count(SYNTHETIC) == 2
+    assert custom_call_targets(SYNTHETIC) == [
+        "xla_python_cpu_callback", "tpu_custom_call",
+    ]
+    assert scatter_result_dims(SYNTHETIC) == [(2, 1024, 16)]
+    assert f32_upcast_store_dots(SYNTHETIC) == ["dot.2"]
+    assert "f32" in dot_store_dtypes(SYNTHETIC)
+    assert forbidden_gather_findings(SYNTHETIC, {(256, 64)}) == [
+        ("f32", (256, 64)), ("f32", (256, 64)),
+    ]
+
+
+@pytest.mark.fast
+def test_synthetic_rules_fire_and_policies_gate():
+    # census: all-gather banned for copy families, fine for forward
+    fs = _findings(
+        SYNTHETIC, CollectiveCensusRule(), family="kv_adopt",
+        policy=FamilyPolicy(allowed_collectives=frozenset()),
+    )
+    assert {"all-gather", "all-reduce"} <= {
+        f.message.split("'")[1] for f in fs
+    }
+    assert not _findings(SYNTHETIC, CollectiveCensusRule())
+    # census: full-table regather + size cap
+    fs = _findings(
+        SYNTHETIC, CollectiveCensusRule(),
+        policy=FamilyPolicy(forbidden_gather_dims=frozenset({(256, 64)})),
+    )
+    assert any("reassembles a full sharded table" in f.message for f in fs)
+    fs = _findings(
+        SYNTHETIC, CollectiveCensusRule(),
+        policy=FamilyPolicy(max_allgather_elements=1000),
+    )
+    assert any("exceeds the family size cap" in f.message for f in fs)
+    # host: the python callback flags, the Pallas kernel target does NOT
+    fs = _findings(SYNTHETIC, HostRoundTripRule())
+    msgs = " ".join(f.message for f in fs)
+    assert "xla_python_cpu_callback" in msgs
+    assert "tpu_custom_call" not in msgs
+    assert "f64 tensor" in msgs  # constant.7
+    assert not _findings(
+        SYNTHETIC, HostRoundTripRule(),
+        policy=FamilyPolicy(forbid_host=False, forbid_f64=False),
+    )
+    # dtype: the bf16 -> f32 store upcast fires only when the policy asks
+    fs = _findings(
+        SYNTHETIC, DtypePolicyRule(),
+        policy=FamilyPolicy(forbid_f32_upcast_store=True),
+    )
+    assert any("accumulate-and-store" in f.message for f in fs)
+    assert not _findings(SYNTHETIC, DtypePolicyRule())
+    # dtype: store-width cap (f32 store > 16-bit limit)
+    fs = _findings(
+        SYNTHETIC, DtypePolicyRule(),
+        policy=FamilyPolicy(max_dot_store_bits=16),
+    )
+    assert any("wider than the 16-bit family limit" in f.message for f in fs)
+    # donation: 2 aliases present, 2 expected -> quiet; 3 expected -> fires
+    assert not _findings(SYNTHETIC, DonationRule(), expected_aliases=2)
+    fs = _findings(SYNTHETIC, DonationRule(), expected_aliases=3)
+    assert fs and "donation dropped" in fs[0].message
+
+
+@pytest.mark.fast
+def test_cost_budget_rule_and_finding_fingerprints():
+    cost = {"flops": 100.0, "bytes_accessed": 1000.0}
+    assert not _findings(
+        SYNTHETIC, CostBudgetRule(), cost=cost,
+        bytes_budget=2000.0, flops_budget=200.0,
+    )
+    fs = _findings(
+        SYNTHETIC, CostBudgetRule(), cost=cost,
+        bytes_budget=500.0, flops_budget=50.0,
+    )
+    assert len(fs) == 2
+    assert all("roofline budget" in f.message for f in fs)
+    # raw numbers live in detail (rendered) but NOT in the fingerprint,
+    # so a backend that shifts bytes_accessed does not churn the baseline
+    f = fs[0]
+    assert isinstance(f, HloFinding)
+    assert ">" in f.render() and "e+" in f.render()
+    assert "e+" not in f.fingerprint()
+    drifted = HloFinding(
+        rule=f.rule, path=f.path, line=1, message=f.message, detail="other"
+    )
+    assert drifted.fingerprint() == f.fingerprint()
+
+
+@pytest.mark.fast
+def test_program_cost_ceilings_math():
+    from dllama_tpu.obs.cost import program_cost_ceilings
+
+    fwd = program_cost_ceilings(
+        "decode_lanes", steps=8, tokens=4,
+        param_bytes=1e6, cache_bytes=2e5, param_elems=2.5e5,
+        cache_elems=5e4,
+    )
+    # slack(8) * steps(8) * (param + (1+tokens)*cache bytes)
+    assert fwd["bytes_accessed"] == pytest.approx(8 * 8 * 2e6)
+    assert fwd["flops"] > 8 * 8 * 2 * 2.5e5 * 4
+    copy = program_cost_ceilings(
+        "kv_adopt", cache_bytes=2e5, pool_bytes=3e5, cache_elems=5e4
+    )
+    # copy programs: bytes scale with the two buffers, flops ~allowance
+    assert copy["bytes_accessed"] == pytest.approx(8 * 5e5)
+    assert copy["flops"] < fwd["flops"]
+
+
+# -- seeded violations on REAL compiled programs ----------------------------
+
+@pytest.mark.fast
+def test_dropped_donation_fires_on_real_executable():
+    c = jnp.zeros((128,), jnp.float32)
+    honored = _compile(lambda c: c * 2.0, c, donate=(0,)).as_text()
+    dropped = _compile(lambda c: c * 2.0, c).as_text()
+    assert input_output_alias_count(honored) == 1
+    assert input_output_alias_count(dropped) == 0
+    assert not _findings(honored, DonationRule(), expected_aliases=1)
+    fs = _findings(dropped, DonationRule(), expected_aliases=1)
+    assert fs and "donation dropped: 1 of 1" in fs[0].message
+
+
+@pytest.mark.fast
+def test_full_table_allgather_fires_on_real_executable():
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    table = jax.device_put(
+        jnp.ones((256, 64), jnp.float32), NamedSharding(mesh, P("tp", None))
+    )
+
+    def regather(w):
+        # the classic slip: force the sharded table replicated on-chip
+        return jax.lax.with_sharding_constraint(
+            w + 1.0, NamedSharding(mesh, P(None, None))
+        )
+
+    txt = _compile(regather, table).as_text()
+    assert ("f32", (256, 64)) in gather_result_shapes(txt)
+    fs = _findings(
+        txt, CollectiveCensusRule(),
+        policy=FamilyPolicy(
+            forbidden_gather_dims=frozenset({(256, 64), (64, 256)})
+        ),
+    )
+    assert fs and "reassembles a full sharded table 256x64" in fs[0].message
+
+
+@pytest.mark.fast
+def test_host_callback_fires_on_real_executable():
+    def fn(x):
+        return jax.pure_callback(
+            lambda a: np.asarray(a) * 2.0,
+            jax.ShapeDtypeStruct((4,), jnp.float32),
+            x,
+        )
+
+    txt = _compile(fn, jnp.ones((4,), jnp.float32)).as_text()
+    fs = _findings(txt, HostRoundTripRule())
+    assert fs, custom_call_targets(txt)
+    assert any("host-transfer custom-call" in f.message for f in fs)
+
+
+@pytest.mark.fast
+def test_f32_upcast_store_fires_on_real_executable():
+    a = jnp.ones((8, 16), jnp.bfloat16)
+    b = jnp.ones((16, 8), jnp.bfloat16)
+
+    def upcast(a, b):
+        return jnp.dot(a.astype(jnp.float32), b.astype(jnp.float32))
+
+    def stays16(a, b):
+        return jnp.dot(a, b)  # bf16 store (whatever the accumulator)
+
+    pol = FamilyPolicy(forbid_f32_upcast_store=True)
+    txt = _compile(upcast, a, b).as_text()
+    fs = _findings(txt, DtypePolicyRule(), policy=pol)
+    assert fs and "accumulate-and-store" in fs[0].message
+    assert not _findings(
+        _compile(stays16, a, b).as_text(), DtypePolicyRule(), policy=pol
+    )
+
+
+@pytest.mark.fast
+def test_cost_budget_fires_on_real_executable():
+    from dllama_tpu.obs.cost import extract_cost
+
+    w = jnp.ones((128, 128), jnp.float32)
+    compiled = _compile(lambda w: w @ w, w)
+    cost = extract_cost(compiled)
+    assert cost is not None and cost["flops"] > 0
+    fs = _findings(
+        compiled.as_text(), CostBudgetRule(), cost=cost,
+        bytes_budget=1.0, flops_budget=1.0,
+    )
+    assert len(fs) == 2
+
+
+@pytest.mark.fast
+def test_xlalint_baseline_prune_helpers(tmp_path):
+    bp = tmp_path / "xlalint-baseline.json"
+    write_baseline_fingerprints(bp, ["r::p::gone", "r::p::alive"])
+    baseline = load_baseline(bp)
+    live = [HloFinding(rule="r", path="p", line=1, message="alive")]
+    new, old, stale = apply_baseline(live, baseline)
+    assert not new and len(old) == 1 and stale == {"r::p::gone"}
+    write_baseline_fingerprints(bp, baseline - stale)
+    assert json.loads(bp.read_text())["findings"] == ["r::p::alive"]
+
+
+@pytest.mark.fast
+def test_hlo_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dllama_tpu.analysis", "--hlo",
+         "--list-rules"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    for r in all_hlo_rules():
+        assert r.name in proc.stdout
+
+
+# -- clean-engine smoke (the CI gate, in-process) ---------------------------
+
+@pytest.fixture(scope="module")
+def tiny_engine(tmp_path_factory):
+    from dllama_tpu.runtime.engine import InferenceEngine
+
+    mp = str(tmp_path_factory.mktemp("xlalint") / "tiny.m")
+    make_tiny_model(mp)
+    eng = InferenceEngine(
+        mp, dtype=jnp.float32, temperature=0.0, batch_size=2,
+        prefill_buckets=(8,),
+    )
+    eng.init_kv_pool(page_size=8)
+    eng.rehearse_admission(block_size=8, wait=True)
+    return eng
+
+
+@pytest.mark.fast
+def test_clean_engine_zero_new_findings(tiny_engine):
+    rep = tiny_engine.xlalint_report()
+    assert rep["new_findings"] == [], rep["new_findings"]
+    assert rep["n_programs"] >= 3  # prefill bucket + decode block + kv
+    families = {p["family"] for p in rep["programs"]}
+    assert {"prefill_lane", "decode_lanes", "kv_adopt", "kv_publish"} <= (
+        families
+    )
+    # every AOT program carried a cost and a positive budget
+    for p in rep["programs"]:
+        assert p["bytes_budget"] > 0 and p["flops_budget"] > 0
+        assert p["expected_aliases"] >= 1
+
+
+@pytest.mark.fast
+def test_engine_strict_mode_raises_through_compile_hook(tiny_engine):
+    from dllama_tpu.analysis.xlalint import XlalintError
+
+    class FakeExecutable:
+        def as_text(self):
+            # a lane program with NO input_output_alias: donation dropped
+            return "HloModule broken\nENTRY %main { ROOT %r = f32[1]{0} parameter(0) }\n"
+
+        def cost_analysis(self):
+            return {"flops": 0.0, "bytes accessed": 0.0}
+
+    key = ("lane_prefill", 999, 64)
+    with tiny_engine._compile_lock:
+        tiny_engine._compiled[key] = FakeExecutable()
+    old_mode = tiny_engine._xlalint_mode
+    try:
+        tiny_engine._xlalint_mode = "strict"
+        with pytest.raises(XlalintError, match="donation"):
+            tiny_engine._xlalint_after_compile(key)
+        # warn mode: same finding only logs (and counts) — no raise
+        tiny_engine._xlalint_mode = "warn"
+        tiny_engine._xlalint_after_compile(key)
+        # off: hook is a no-op even on the broken program
+        tiny_engine._xlalint_mode = "off"
+        tiny_engine._xlalint_after_compile(key)
+    finally:
+        tiny_engine._xlalint_mode = old_mode
+        with tiny_engine._compile_lock:
+            del tiny_engine._compiled[key]
