@@ -744,6 +744,166 @@ def _serving_smoke(n_clients: int) -> dict:
     reg.enable()
     overhead_pct = (on_s - off_s) / off_s * 100.0 if off_s > 0 else 0.0
 
+    # self-healing under chaos (ISSUE 12): seeded fault rounds against a
+    # fresh server — completion rate under a retryable transient schedule
+    # (the CI gate holds it at 1.0 with every stream byte-identical to
+    # the fault-free round), recovered-lane count and the p99 inter-delta
+    # gap through a poison recovery vs fault-free, and the shed counter
+    # under queue pressure. docs/resilience.md is the map.
+    from dllama_tpu.runtime.faults import set_fault_plane
+
+    engine_res = InferenceEngine(
+        model_path, tokenizer=tok, batch_size=n_lanes, temperature=0.0
+    )
+    srv_res = serve(
+        engine_res, tok, host="127.0.0.1", port=0, admission_chunk=32,
+    )
+    port_res = srv_res.server_address[1]
+    threading.Thread(  # dlint: disable=thread-hygiene — serve_forever exits at srv_res.shutdown() below; no handle needed
+        target=srv_res.serve_forever, daemon=True,
+        name="dllama-bench-http-res",
+    ).start()
+    res_prompts = [f"resilience workload item {i}" for i in range(6)]
+
+    def res_round() -> tuple[dict, int]:
+        """One concurrent round: ({index: content} for completed
+        requests, count of structured-retryable failures)."""
+        results: dict = {}
+
+        def one(i: int) -> None:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port_res, timeout=300
+            )
+            conn.request(
+                "POST", "/v1/chat/completions",
+                json.dumps({
+                    "messages": [
+                        {"role": "user", "content": res_prompts[i]}
+                    ],
+                    "max_tokens": 12, "temperature": 0.0,
+                }),
+                {"Content-Type": "application/json"},
+            )
+            r = conn.getresponse()
+            results[i] = (r.status, json.loads(r.read().decode("utf-8")))
+            conn.close()
+
+        ths = [
+            threading.Thread(
+                target=one, args=(i,), daemon=True,
+                name=f"dllama-bench-res-{i}",
+            )
+            for i in range(len(res_prompts))
+        ]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        contents, n_retryable = {}, 0
+        for i, (status, body) in results.items():
+            if status == 200:
+                contents[i] = body["choices"][0]["message"]["content"]
+            elif body.get("error", {}).get("retryable"):
+                n_retryable += 1
+        return contents, n_retryable
+
+    res_round()                    # warm: compiles + first publishes
+    res_baseline, _ = res_round()  # fault-free reference bytes
+
+    plane = set_fault_plane("dispatch:p=0.05:seed=7")
+    res_faulted, _ = res_round()
+    transient_injected = plane.counts().get("dispatch", 0)
+    set_fault_plane("")
+    byte_identical = sum(
+        1 for i, c in res_faulted.items() if res_baseline.get(i) == c
+    )
+
+    # poison recovery: a victim stream measures its inter-delta gaps
+    # while a mid-stream decode poison forces its lane through the
+    # re-prefill resume path; the same stream fault-free is the baseline
+    def victim_gaps(spec: str | None) -> list[float]:
+        arrivals: list[float] = []
+        conn = http.client.HTTPConnection("127.0.0.1", port_res, timeout=300)
+        conn.request(
+            "POST", "/v1/chat/completions",
+            json.dumps({
+                "messages": [{"role": "user", "content": "chaos victim"}],
+                "max_tokens": 48, "stream": True, "temperature": 0.0,
+            }),
+            {"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        while True:
+            line = r.readline()
+            if not line or b"[DONE]" in line:
+                break
+            if line.startswith(b"data:"):
+                arrivals.append(time.perf_counter())
+                if spec is not None and len(arrivals) == 1:
+                    set_fault_plane(spec)  # decode is in flight: arm now
+                    spec = None
+        conn.close()
+        return [(b - a) * 1000 for a, b in zip(arrivals, arrivals[1:])]
+
+    def gap_p99(gaps: list[float]) -> float | None:
+        if not gaps:
+            return None
+        g = sorted(gaps)
+        return round(g[min(len(g) - 1, int(0.99 * (len(g) - 1)))], 2)
+
+    gaps_base = victim_gaps(None)
+    pre_res = scrape_port(port_res)
+    gaps_poison = victim_gaps("dispatch:op=decode_lanes:nth=2:kind=poison")
+    set_fault_plane("")
+    post_res = scrape_port(port_res)
+    recovered = int(
+        metric_value(post_res, "dllama_lanes_recovered_total")
+        - metric_value(pre_res, "dllama_lanes_recovered_total")
+    )
+
+    # load shedding: a sentinel parked in the idle scheduler's queue
+    # (appended WITHOUT a cv notify, so the waiting loop never pops it)
+    # trips the depth gate deterministically
+    st_res = srv_res.state
+    sched_res = st_res.scheduler
+    st_res.max_queue_depth = 1
+    sentinel = object()
+    with sched_res.cv:
+        sched_res.pending.append(sentinel)
+    n_shed = 0
+    for _ in range(2):
+        conn = http.client.HTTPConnection("127.0.0.1", port_res, timeout=30)
+        conn.request(
+            "POST", "/v1/chat/completions",
+            json.dumps({
+                "messages": [{"role": "user", "content": "shed me"}],
+                "max_tokens": 4,
+            }),
+            {"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        r.read()
+        if r.status == 429:
+            n_shed += 1
+        conn.close()
+    with sched_res.cv:
+        sched_res.pending.remove(sentinel)
+    st_res.max_queue_depth = 0
+    srv_res.shutdown()
+
+    resilience = {
+        "n_requests": len(res_prompts),
+        "completion_rate_transient": round(
+            len(res_faulted) / len(res_prompts), 3
+        ),
+        "byte_identical_transient": byte_identical,
+        "faults_injected_transient": int(transient_injected),
+        "recovered_lanes": recovered,
+        "p99_gap_ms_baseline": gap_p99(gaps_base),
+        "p99_gap_ms_recovery": gap_p99(gaps_poison),
+        "requests_shed": n_shed,
+    }
+
     return {
         "n_clients": n_clients,
         "n_traced": len(recs),
@@ -763,6 +923,7 @@ def _serving_smoke(n_clients: int) -> dict:
         ),
         "prefix_fanout": prefix_fanout,
         "speculation": speculation,
+        "resilience": resilience,
         "slo": slo,
         "timeline": timeline,
         "series": series,

@@ -46,6 +46,7 @@ def all_rules() -> list:
     from .rules_clock import DirectClockRule
     from .rules_dashboard import DashboardStaticRule
     from .rules_env import EnvKnobDocsRule
+    from .rules_except import SilentExceptRule
     from .rules_kv import RetainReleaseRule
     from .rules_locks import GuardedAttrsRule
     from .rules_metrics import MetricsDocsRule
@@ -61,4 +62,5 @@ def all_rules() -> list:
         MetricsDocsRule(),
         DashboardStaticRule(),
         EnvKnobDocsRule(),
+        SilentExceptRule(),
     ]

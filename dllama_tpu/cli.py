@@ -159,6 +159,24 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                         "experts (docs/moe_decode_dedup.md); auto = on at "
                         ">= 8 decode lanes (routing-correlation study, "
                         "scripts/moe_routing_sim.py)")
+    p.add_argument("--faults", default=None, metavar="SPEC",
+                   help="arm the deterministic chaos plane with a fault "
+                        "schedule, e.g. 'dispatch:p=0.05:seed=7,"
+                        "kv_alloc:nth=12' (runtime/faults.py; env "
+                        "DLLAMA_FAULTS; docs/resilience.md)")
+    p.add_argument("--retry-max", type=int, default=None,
+                   help="transient-dispatch retries before failing the "
+                        "request (scheduler backoff loop; default 3; "
+                        "0 disables; env DLLAMA_RETRY_MAX)")
+    p.add_argument("--retry-backoff-ms", type=int, default=None,
+                   help="base backoff in ms between dispatch retries, "
+                        "doubling per attempt (default 5; env "
+                        "DLLAMA_RETRY_BACKOFF_MS)")
+    p.add_argument("--max-queue-depth", type=int, default=None,
+                   help="shed (429 + Retry-After) once this many requests "
+                        "wait for a lane; priority 'low' sheds at half "
+                        "this, 'high' at double (default 0 = unbounded; "
+                        "env DLLAMA_MAX_QUEUE_DEPTH)")
     p.add_argument("--sync-measure", default="auto", choices=["auto", "off"],
                    help="measure per-step collective time via a short "
                    "profiled re-run (multi-device greedy runs only; 'off' "

@@ -31,6 +31,7 @@ from ..analysis.lockwatch import make_lock
 from ..obs.metrics import get_registry
 from ..obs.recorder import get_recorder
 from ..obs.spans import get_span_tracker
+from ..runtime.faults import InjectedFault, get_fault_plane
 from .pool import PagePool
 from .radix import RadixTree
 
@@ -213,13 +214,12 @@ class PagedKVManager:
                 diverged_mid_page = (
                     mr.n_tokens > k_shared * ps and len(mr.pages) > k_shared
                 )
-                if diverged_mid_page:
-                    pages = [self.pool.fork(mr.pages[k_shared])]
-                    pages += self.pool.alloc(n_new - 1)
-                else:
-                    pages = self.pool.alloc(n_new)
+                fork_page = mr.pages[k_shared] if diverged_mid_page else None
+                pages = self._alloc_publish_pages(fork_page, n_new, lane)
             finally:
                 self.pool.release(mr.pages)
+            if pages is None:
+                return 0
         try:
             self.engine.kv_publish(lane, pages, start_page=k_shared)
         except BaseException:
@@ -243,6 +243,33 @@ class PagedKVManager:
                 return 0
             self._update_gauges_locked()
         return n_new
+
+    def _alloc_publish_pages(
+        self, fork_page: int | None, n_new: int, lane: int
+    ) -> list[int] | None:
+        """Allocate ``n_new`` pages for a publish, copy-on-write-forking
+        ``fork_page`` as the first when the stored prefix diverged
+        mid-page. Returns None on allocation failure (or an injected
+        ``kv_alloc`` fault) — survivable by design: the stream already
+        served, only future reuse is lost (same degraded-not-dead policy
+        as the full-pool publish skip)."""
+        try:
+            fault = get_fault_plane().draw("kv_alloc", op="publish")
+            if fault is not None:
+                raise fault
+            if fork_page is None:
+                return self.pool.alloc(n_new)
+            rest = self.pool.alloc(n_new - 1)
+            try:
+                return [self.pool.fork(fork_page)] + rest
+            except MemoryError:
+                self.pool.release(rest)
+                raise
+        except (MemoryError, InjectedFault) as e:
+            self.recorder.record(
+                "kv_alloc_failed", lane=lane, want=n_new, error=str(e)
+            )
+            return None
 
     def note_hit(self, n_tokens: int) -> None:
         self.c_hits.inc()

@@ -324,6 +324,22 @@ def _level(store: SeriesStore, name: str) -> Callable[[], float | None]:
     return fn
 
 
+# the series behind the default rules, in one place: postmortem dumps
+# embed the trailing window of exactly these signals (obs/recorder.py
+# context providers), so a ring dump carries the same evidence the live
+# anomaly monitor would have been looking at
+DEFAULT_SIGNAL_SERIES = (
+    "dllama_decode_stall_seconds_sum",
+    "dllama_decode_stall_seconds_count",
+    "dllama_ttft_seconds_sum",
+    "dllama_ttft_seconds_count",
+    "dllama_tpot_seconds_sum",
+    "dllama_tpot_seconds_count",
+    "dllama_kv_pages_free",
+    'dllama_slo_goodput_tokens_per_s{window="1m"}',
+)
+
+
 def build_default_rules(store: SeriesStore) -> list[AnomalyRule]:
     """The production signal set, reading the series the sampler just
     recorded (the monitor runs as an ``on_sample`` callback, after the

@@ -68,6 +68,17 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._seq = 0  # total events ever recorded (ring drops the oldest)
         self._n_postmortems = 0
+        # named callables whose outputs embed into every postmortem dump
+        # (the API server registers a /v1/health snapshot and the last
+        # 60 s of the anomaly-signal series): a ring dump then carries
+        # the server-level evidence, diagnosable without a live server
+        self._context_providers: dict[str, object] = {}
+
+    def add_context_provider(self, key: str, fn) -> None:
+        """Register (or replace) a zero-arg callable whose return value
+        is embedded under ``context[key]`` in postmortem dumps. Keyed so
+        test churn rebuilding server state replaces, never stacks."""
+        self._context_providers[key] = fn
 
     def enable(self) -> None:
         self.enabled = True
@@ -152,6 +163,17 @@ class FlightRecorder:
                 if isinstance(error, BaseException)
                 else None
             )
+            # providers run OUTSIDE the ring lock (dump/record take it)
+            # and individually fail-safe: bad context must never mask
+            # the original failure or the rest of the dump
+            context = {}
+            for key, fn in list(self._context_providers.items()):
+                try:
+                    context[key] = fn()
+                except Exception as e:
+                    context[key] = {"context_error": str(e)}
+            if context:
+                payload["context"] = context
             with open(path, "w") as f:
                 json.dump(payload, f)
             logger.error("postmortem written to %s (reason: %s)", path, reason)

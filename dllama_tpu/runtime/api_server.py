@@ -82,6 +82,7 @@ from ..tokenizer import (
     Tokenizer,
 )
 from .engine import InferenceEngine
+from .faults import get_fault_plane, set_fault_plane
 from .spec import (
     DEFAULT_SPEC_K,
     NgramDrafter,
@@ -160,6 +161,10 @@ class InferenceParams:
     stream: bool = False
     max_tokens: int = -1
     stop: list[str] = field(default_factory=list)
+    # admission priority class for load shedding (docs/resilience.md):
+    # under queue pressure or a degraded engine, "low" sheds first,
+    # "high" last — the reason-tagged 429/503 + Retry-After path
+    priority: str = "normal"
 
 
 class LaneJob:
@@ -228,6 +233,12 @@ class _AdmittingLane:
     adopted: bool = False  # the adopt dispatch ran (it is its own tick)
     n_chunks: int = 0
     prefill_s: float = 0.0  # chunk dispatch time only, decode excluded
+    # crash recovery (PR 12): when set, this admission is a poisoned
+    # lane's resume — `tokens` is the lane's full fed history and
+    # _finish_admission reinstalls this preserved _LaneState (decoder,
+    # detector, counts) instead of building a fresh one, so the client's
+    # stream continues byte-identically after the re-prefill
+    resume_state: "_LaneState | None" = None
 
 
 def _env_int(name: str, default: int) -> int:
@@ -266,6 +277,26 @@ def resolve_kv_knobs(
     if kv_pool_pages is None:
         kv_pool_pages = _env_int("DLLAMA_KV_POOL_PAGES", 0)
     return int(kv_page_size), int(kv_pool_pages)
+
+
+def resolve_resilience_knobs(
+    retry_max: int | None = None,
+    retry_backoff_ms: int | None = None,
+    max_queue_depth: int | None = None,
+) -> tuple[int, int, int]:
+    """Retry/shed knob resolution, same precedence as the lane knobs:
+    explicit (CLI flag) beats env (DLLAMA_RETRY_MAX /
+    DLLAMA_RETRY_BACKOFF_MS / DLLAMA_MAX_QUEUE_DEPTH) beats default.
+    retry_max is attempts AFTER the first failure (0 disables retries);
+    max_queue_depth 0 disables queue-depth shedding (unbounded queue,
+    the pre-PR12 behavior)."""
+    if retry_max is None:
+        retry_max = _env_int("DLLAMA_RETRY_MAX", 3)
+    if retry_backoff_ms is None:
+        retry_backoff_ms = _env_int("DLLAMA_RETRY_BACKOFF_MS", 5)
+    if max_queue_depth is None:
+        max_queue_depth = _env_int("DLLAMA_MAX_QUEUE_DEPTH", 0)
+    return int(retry_max), int(retry_backoff_ms), int(max_queue_depth)
 
 
 class LaneScheduler:
@@ -335,6 +366,14 @@ class LaneScheduler:
         # scheduler tests replace it; production uses the monotonic timer)
         self._clock = time.perf_counter
         self._last_decode_end: float | None = None
+        # transient-dispatch retry policy (resolve_resilience_knobs):
+        # attempts after the first failure, exponential backoff base.
+        # _sleep is injectable so chaos tests don't pay real backoff.
+        self.retry_max = int(getattr(state, "retry_max", 3))
+        self.retry_backoff_s = (
+            int(getattr(state, "retry_backoff_ms", 5)) / 1000.0
+        )
+        self._sleep = time.sleep
         self.pending: list[LaneJob] = []
         self.cv = make_condition("sched.cv")
         self._stop = False
@@ -378,6 +417,157 @@ class LaneScheduler:
         self.state.m_lanes_active.set(
             sum(1 for ls in self.lanes if ls is not None)
         )
+
+    # -- failure classification + recovery (PR 12) -------------------------
+
+    def _retry_dispatch(self, what: str, fn):
+        """Bounded exponential-backoff retry for engine dispatches whose
+        failure left the donated buffers intact: the cache epoch did not
+        move, so the guard never fired, lane KV is exactly as it was
+        before the call, and re-issuing the dispatch is safe and
+        idempotent. A failure that DID move the epoch re-raises
+        immediately — retrying against the rebuilt (zeroed) cache would
+        decode garbage; the caller's recovery path owns that class."""
+        attempt = 0
+        while True:
+            epoch = self.engine.cache_epoch
+            try:
+                return fn()
+            except Exception as e:
+                if (
+                    self.engine.cache_epoch != epoch
+                    or attempt >= self.retry_max
+                ):
+                    raise
+                attempt += 1
+                self.state.m_dispatch_retries.inc()
+                self.state.recorder.record(
+                    "dispatch_retry", step=what, attempt=attempt,
+                    error=str(e), error_type=type(e).__name__,
+                )
+                self._sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+
+    def _fail_active(self, lane: int, err: dict) -> None:
+        """Error out one ACTIVE lane's request with a structured payload
+        and free the lane (no publish: its slab KV is not trustworthy on
+        any path that reaches here)."""
+        ls = self.lanes[lane]
+        self.state.spans.end(ls.decode_span, error=err["message"])
+        ls.job.events.put(("error", err))
+        if ls.job.span.finish(
+            "error", n_completion=ls.job.n_completion
+        ) is not None:
+            self.state.m_finished.labels(reason="error").inc()
+        self.lanes[lane] = None
+        self.drafters.pop(lane, None)
+        if self.kv is not None:
+            self.kv.release_lane(lane)
+
+    def _fail_admitting(self, lane: int, err: dict) -> None:
+        """Error out one MID-ADMISSION request with a structured payload,
+        releasing its adopted-page retains (satellite-audited leak path:
+        every drop route must pop self.admitting AND release the lane)."""
+        adm = self.admitting.pop(lane, None)
+        if adm is None:
+            return
+        if adm.resume_state is not None:
+            # a recovery resume that failed again: the original stream's
+            # decode span is still open — close it with the error
+            self.state.spans.end(
+                adm.resume_state.decode_span, error=err["message"]
+            )
+        adm.job.events.put(("error", err))
+        if adm.job.span.finish(
+            "error", n_completion=adm.job.n_completion
+        ) is not None:
+            self.state.m_finished.labels(reason="error").inc()
+        self.drafters.pop(lane, None)
+        if self.kv is not None:
+            self.kv.release_lane(lane)
+
+    def _drop_all(self, e: Exception) -> None:
+        """Retries exhausted on an intact cache (or recovery itself is
+        impossible): fail every in-flight request with a structured
+        RETRYABLE error and keep the scheduler thread alive — the
+        pre-PR 12 behavior, now with clients told to come back."""
+        err = {"message": str(e), "retryable": True}
+        for lane in range(len(self.lanes)):
+            if self.lanes[lane] is not None:
+                self._fail_active(lane, err)
+        # iterate the dict, not range(len(lanes)): an admitting lane is
+        # exactly the kind of entry a lanes-indexed loop can miss
+        for lane in list(self.admitting):
+            self._fail_admitting(lane, err)
+        if self.kv is not None:
+            # belt and suspenders after the per-lane releases: no retain
+            # may survive a drop-all (pool pages themselves are NOT
+            # donated by decode/prefill, so stored prefixes stay valid)
+            self.kv.release_all_lanes()
+        self.drafters.clear()
+        self._set_lane_gauge()
+
+    def _recover(self, e: Exception, culprit: int | None) -> None:
+        """A poisoning failure rebuilt the donated cache: every lane's
+        slab KV is zeroed, but the shared page pool is NOT (dispatches
+        never donate it), so each surviving lane's state is recoverable
+        from host-side truth. Active lanes flip back to _AdmittingLane
+        resumes: radix re-match their fed history against the pool
+        (published prefixes adopt back in; only the unpublished suffix
+        re-prefills, chunked as usual) and the preserved _LaneState is
+        reinstalled on completion — the client's stream continues
+        byte-identically, never seeing the fault. Mid-admission lanes
+        rewind their chunk cursor to the adopted prefix (their page
+        retains survived). Only ``culprit`` — the lane whose own
+        admission dispatch poisoned the cache — gets a structured
+        retryable error."""
+        err = {"message": str(e), "retryable": True}
+        n_resumed = 0
+        for lane in list(self.admitting):
+            adm = self.admitting[lane]
+            if lane == culprit:
+                self._fail_admitting(lane, err)
+                continue
+            # the partial prefill died with the cache; the adopt copy
+            # must re-run too (it targeted the old buffer)
+            adm.cursor = adm.start_pos
+            adm.adopted = False
+        for lane in range(len(self.lanes)):
+            ls = self.lanes[lane]
+            if ls is None:
+                continue
+            if lane == culprit:
+                self._fail_active(lane, err)
+                continue
+            if ls.job.cancelled:
+                # no client to resume for; _finish("cancelled") publishes
+                # nothing (the slab KV backing the history is garbage)
+                self._finish(lane, "cancelled")
+                continue
+            self.lanes[lane] = None
+            start_pos, pages = 0, []
+            if self.kv is not None:
+                start_pos, pages = self.kv.match(lane, ls.history)
+            self.admitting[lane] = _AdmittingLane(
+                job=ls.job,
+                tokens=list(ls.history),
+                pos0=0,
+                cursor=start_pos,
+                prompt_end=len(ls.history) - 1,
+                max_pos=ls.max_pos,
+                public_prompt="",
+                start_pos=start_pos,
+                adopt_pages=pages,
+                resume_state=ls,
+            )
+            n_resumed += 1
+        self.state.recorder.record(
+            "lane_recovery", error=str(e), error_type=type(e).__name__,
+            culprit=culprit, n_resumed=n_resumed,
+            n_admitting=len(self.admitting),
+        )
+        self._set_lane_gauge()
+        with self.cv:
+            self.cv.notify_all()
 
     # -- scheduler thread --------------------------------------------------
 
@@ -432,65 +622,49 @@ class LaneScheduler:
             # lane is mid-stream
             self._admission_tick()
             if any(self.lanes):
+                epoch0 = self.engine.cache_epoch
                 try:
                     self._step_block()
                 except Exception as e:
-                    # the scheduler thread must survive any engine error:
-                    # fail every in-flight request loudly and keep serving
+                    # the scheduler thread must survive any engine error
                     # (the reference's crash-retry loop plays this role
-                    # for its single stream, dllama-api.cpp:616-628). The
-                    # failed dispatch donated the KV cache buffer, so NO
-                    # lane's cached conversation can be trusted afterwards
-                    # — drop them all rather than resume on corrupt KV.
+                    # for its single stream, dllama-api.cpp:616-628).
+                    # _retry_dispatch already absorbed transient failures;
+                    # what reaches here is classified by the cache epoch:
+                    # moved => the dispatch guard rebuilt the donated
+                    # cache (every lane's slab KV is gone) and the lanes
+                    # RESUME from the shared page pool; unchanged =>
+                    # retries exhausted on an intact cache — fail the
+                    # in-flight requests with a structured retryable
+                    # error and keep serving.
                     import logging
 
+                    poisoned = self.engine.cache_epoch != epoch0
                     logging.getLogger(__name__).exception(
-                        "lane scheduler step failed; dropping all "
-                        "in-flight lanes"
+                        "lane scheduler step failed (%s); %s",
+                        "cache poisoned" if poisoned else "cache intact",
+                        "recovering lanes" if poisoned
+                        else "dropping in-flight lanes",
                     )
                     self.state.m_sched_errors.inc()
                     self.state.recorder.record(
                         "scheduler_error",
                         error=str(e),
                         error_type=type(e).__name__,
-                        n_lanes_dropped=sum(
+                        poisoned=poisoned,
+                        n_lanes=sum(
                             1 for ls in self.lanes if ls is not None
                         ),
                     )
                     # black-box dump: the ring holds the dispatches that
                     # led here (written only when a postmortem dir is set)
                     self.state.recorder.postmortem("scheduler-loop", e)
-                    for lane in range(len(self.lanes)):
-                        if self.lanes[lane] is not None:
-                            job = self.lanes[lane].job
-                            self.state.spans.end(
-                                self.lanes[lane].decode_span, error=str(e)
-                            )
-                            job.events.put(("error", str(e)))
-                            if job.span.finish(
-                                "error", n_completion=job.n_completion
-                            ):
-                                self.state.m_finished.labels(
-                                    reason="error"
-                                ).inc()
-                            self.lanes[lane] = None
-                        # mid-admission requests sit on the same donated
-                        # cache: their partial prefills are gone too
-                        adm = self.admitting.pop(lane, None)
-                        if adm is not None:
-                            adm.job.events.put(("error", str(e)))
-                            if adm.job.span.finish("error") is not None:
-                                self.state.m_finished.labels(
-                                    reason="error"
-                                ).inc()
-                    if self.kv is not None:
-                        # the failed dispatch donated the lane CACHE, not
-                        # the page pool (decode/prefill never donate it):
-                        # stored prefixes stay valid, only the dropped
-                        # lanes' page retains need releasing
-                        self.kv.release_all_lanes()
-                    self.drafters.clear()
-                    self._set_lane_gauge()
+                    if poisoned:
+                        # batched dispatch: no single lane is culpable, so
+                        # every lane resumes (none of them caused it)
+                        self._recover(e, culprit=None)
+                    else:
+                        self._drop_all(e)
                     with self.cv:
                         self.cv.notify_all()
             self.state.spans.end(tick_sp)
@@ -569,7 +743,11 @@ class LaneScheduler:
             )
         except Exception as e:
             state.spans.end(job.queue_span, error=str(e))
-            job.events.put(("error", str(e)))
+            # validation failures (bad template, prompt too long) are the
+            # client's to fix, not to retry — retryable stays False
+            job.events.put(
+                ("error", {"message": str(e), "retryable": False})
+            )
             if job.span.finish("error") is not None:
                 state.m_finished.labels(reason="error").inc()
             if self.kv is not None:
@@ -594,6 +772,7 @@ class LaneScheduler:
         fills = adm.tokens[:-1]
         wd = self.state.watchdog
         rid = job.span.request_id
+        epoch0 = self.engine.cache_epoch
         try:
             if adm.adopt_pages and not adm.adopted:
                 # the adopt copy is this lane's first tick action and is
@@ -607,7 +786,10 @@ class LaneScheduler:
                     wd.dispatch_begin("kv_adopt")
                 t0 = self._clock()
                 try:
-                    self.kv.adopt(lane, adm.adopt_pages)
+                    self._retry_dispatch(
+                        "kv_adopt",
+                        lambda: self.kv.adopt(lane, adm.adopt_pages),
+                    )
                 finally:
                     if wd is not None:
                         wd.dispatch_end()
@@ -623,11 +805,14 @@ class LaneScheduler:
                     wd.dispatch_begin("prefill_lane_chunk")
                 t0 = self._clock()
                 try:
-                    width = self.engine.prefill_lane_chunk(
-                        lane,
-                        fills[adm.cursor:],
-                        adm.pos0 + adm.cursor,
-                        budget=self.admission_chunk,
+                    width = self._retry_dispatch(
+                        "prefill_lane_chunk",
+                        lambda: self.engine.prefill_lane_chunk(
+                            lane,
+                            fills[adm.cursor:],
+                            adm.pos0 + adm.cursor,
+                            budget=self.admission_chunk,
+                        ),
                     )
                 finally:
                     if wd is not None:
@@ -647,16 +832,27 @@ class LaneScheduler:
             ):
                 self._finish_admission(lane, adm)
         except Exception as e:
-            # a failed adopt/chunk releases the lane exactly like the old
-            # monolithic failure path: error the job and drop any page
-            # retains (the lane's partial KV is overwritten by the next
-            # admission anyway)
-            job.events.put(("error", str(e)))
-            if job.span.finish("error") is not None:
-                self.state.m_finished.labels(reason="error").inc()
-            self.admitting.pop(lane, None)
-            if self.kv is not None:
-                self.kv.release_lane(lane)
+            self.state.recorder.record(
+                "admission_error", lane=lane, error=str(e),
+                error_type=type(e).__name__,
+                poisoned=self.engine.cache_epoch != epoch0,
+            )
+            if self.engine.cache_epoch != epoch0:
+                # the failed adopt/chunk ran inside the engine's donated-
+                # buffer guard: the WHOLE cache was rebuilt, so every
+                # other lane's slab KV died with this admission — recover
+                # them all, failing only this lane's request (before
+                # PR 12 this path silently left active lanes decoding
+                # against a zeroed cache)
+                self._recover(e, culprit=lane)
+            else:
+                # cache intact (retries exhausted on a transient fault):
+                # only this admission is affected — error the job and
+                # drop its page retains (the lane's partial KV is
+                # overwritten by the next admission anyway)
+                self._fail_admitting(
+                    lane, {"message": str(e), "retryable": True}
+                )
 
     def _finish_admission(self, lane: int, adm: _AdmittingLane) -> None:
         """Last fill token landed: install the decode-side _LaneState.
@@ -666,6 +862,23 @@ class LaneScheduler:
         how blocks split — or how its admission was chunked."""
         state, tok = self.state, self.state.tokenizer
         job, p = adm.job, adm.job.params
+        if adm.resume_state is not None:
+            # crash-recovery resume (see _recover): the re-prefill just
+            # restored KV rows [0, pos) of the preserved lane state's
+            # history — reinstall that state untouched (stream decoder,
+            # EOS detector, token counts all intact) and the client's
+            # stream continues exactly where the poisoned dispatch cut
+            # it off. No prompt delta, no fresh spans, no second
+            # "admit": the request never observably restarted.
+            self.lanes[lane] = adm.resume_state
+            del self.admitting[lane]
+            state.m_lanes_recovered.inc()
+            self._set_lane_gauge()
+            state.recorder.record(
+                "lane_recovered", lane=lane, pos=adm.resume_state.pos,
+                reused_prefix_tokens=adm.start_pos, n_chunks=adm.n_chunks,
+            )
+            return
         job.span.set_prefill_seconds(adm.prefill_s)
         job.span.set_tokens(n_prompt=len(adm.tokens))
         state.m_prefill.observe(adm.prefill_s)
@@ -711,19 +924,24 @@ class LaneScheduler:
         """Client went away mid-admission: stop prefilling for nobody."""
         adm = self.admitting.pop(lane)
         job = adm.job
+        if adm.resume_state is not None:
+            # recovery resume cancelled mid-re-prefill: the original
+            # stream's decode span is still open — close it here
+            self.state.spans.end(adm.resume_state.decode_span, reason=reason)
         if job.span.finish(
-            reason, n_prompt=len(adm.tokens), n_completion=0
+            reason, n_prompt=len(adm.tokens), n_completion=job.n_completion
         ) is not None:
             self.state.m_finished.labels(reason=reason).inc()
             if reason == "cancelled":
                 self.state.m_cancellations.inc()
         job.events.put(("done", reason))
+        self.drafters.pop(lane, None)
         if self.kv is not None:
             # nothing publishable mid-admission; just drop page retains
             self.kv.release_lane(lane)
         self.state.recorder.record(
             "finish", lane=lane, reason=reason, pos=adm.pos0 + adm.cursor,
-            n_completion=0,
+            n_completion=job.n_completion,
         )
 
     def _finish(self, lane: int, reason: str) -> None:
@@ -864,7 +1082,10 @@ class LaneScheduler:
         if wd is not None:
             wd.dispatch_begin("verify_lanes")
         try:
-            grid = self.engine.verify_lanes(rows, pos, act)
+            grid = self._retry_dispatch(
+                "verify_lanes",
+                lambda: self.engine.verify_lanes(rows, pos, act),
+            )
         finally:
             if wd is not None:
                 wd.dispatch_end()
@@ -949,9 +1170,12 @@ class LaneScheduler:
         if wd is not None:
             wd.dispatch_begin("decode_lanes")
         try:
-            rows = self.engine.decode_lanes(
-                tokens, pos, self.block_size, active, temps, topps,
-                seeds=seeds
+            rows = self._retry_dispatch(
+                "decode_lanes",
+                lambda: self.engine.decode_lanes(
+                    tokens, pos, self.block_size, active, temps, topps,
+                    seeds=seeds
+                ),
             )
         finally:
             if wd is not None:
@@ -999,11 +1223,25 @@ class ApiState:
         series_retention: float | None = None,
         speculation: str = "off",
         spec_k: int = DEFAULT_SPEC_K,
+        retry_max: int = 3,
+        retry_backoff_ms: int = 5,
+        max_queue_depth: int = 0,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.start_unix = time.time()
+        # resilience knobs (resolve_resilience_knobs): the scheduler reads
+        # the retry policy off this state; admission_decision() reads the
+        # shed threshold (0 = unbounded queue, shedding off)
+        self.retry_max = int(retry_max)
+        self.retry_backoff_ms = int(retry_backoff_ms)
+        self.max_queue_depth = int(max_queue_depth)
+        # graceful drain (POST /v1/drain, SIGTERM): admission stops, the
+        # in-flight streams finish, sinks flush, /v1/health says so
+        self.draining = False
+        self.draining_since: float | None = None
+        self.drained = threading.Event()
         # serving observability (obs/): the registry families behind
         # GET /metrics and the tracer behind --trace-out. Handles are
         # created up front (before the scheduler thread starts using them)
@@ -1120,6 +1358,31 @@ class ApiState:
             "Engine errors swallowed by the lane-scheduler loop (each one "
             "dropped every in-flight lane; see the traceback log).",
         )
+        # resilience (PR 12): retry/recovery/shed/drain observability
+        self.m_dispatch_retries = self.obs.counter(
+            "dllama_dispatch_retries_total",
+            "Transient engine-dispatch failures re-issued by the "
+            "scheduler's bounded-backoff retry (the cache epoch did not "
+            "move, so lane KV survived the failure).",
+        )
+        self.m_lanes_recovered = self.obs.counter(
+            "dllama_lanes_recovered_total",
+            "Lanes resumed after a poisoning dispatch failure: the donated "
+            "cache was rebuilt, the lane radix re-matched its published "
+            "prefix and re-prefilled the unpublished suffix, and its "
+            "stream continued byte-identically.",
+        )
+        self.m_shed = self.obs.counter(
+            "dllama_requests_shed_total",
+            "Requests refused at admission with 429/503 + Retry-After, by "
+            "reason (draining / queue_full / degraded).",
+            labelnames=("reason",),
+        )
+        self.g_draining = self.obs.gauge(
+            "dllama_draining",
+            "1 while the server drains (admission stopped, in-flight "
+            "streams finishing), else 0.",
+        )
         self.m_admission_chunks = self.obs.counter(
             "dllama_admission_chunks_total",
             "Bounded prefill chunks dispatched by the chunked admission "
@@ -1216,8 +1479,149 @@ class ApiState:
         self.m_lanes_total.set(
             engine.batch_size if self.scheduler is not None else 1
         )
+        # postmortem context (satellite, PR 12): every ring dump embeds a
+        # /v1/health snapshot plus the trailing 60 s of the anomaly-rule
+        # series, so a dump is diagnosable without the live server
+        self.recorder.add_context_provider("health", self.health_snapshot)
+        self.recorder.add_context_provider("series_60s", self._series_context)
         # sampler last: every gauge/hook it snapshots now exists
         self.sampler.start()
+
+    # -- health / drain / shed (PR 12) -----------------------------------
+
+    def degraded_reasons(self) -> list[str]:
+        """Composed degradation: the watchdog (hard stall) and the anomaly
+        monitor (soft baseline deviation) each contribute reasons — never
+        last-writer-wins. Shared by /v1/health and admission_decision."""
+        reasons: list[str] = []
+        wd = self.watchdog
+        if wd is not None and wd.degraded:
+            reasons.append(f"watchdog:{wd.status().get('reason')}")
+        if self.anomaly.degraded:
+            reasons.extend(
+                f"anomaly:{s}" for s in self.anomaly.active_signals()
+            )
+        return reasons
+
+    def health_snapshot(self) -> dict:
+        """The /v1/health payload — also embedded into postmortem dumps
+        via the recorder's context providers, so it must never take the
+        scheduler cv (a postmortem can fire on the scheduler thread):
+        the lane/pending reads are GIL-atomic snapshots."""
+        sched = self.scheduler
+        total = self.engine.batch_size if sched is not None else 1
+        if sched is not None:
+            active = sum(1 for ls in sched.lanes if ls is not None)
+            queued = len(sched.pending)
+        else:
+            active = 1 if self.lock.locked() else 0
+            queued = 0
+        payload = {
+            "status": "ok",
+            "model": self.model_name,
+            "uptime_s": round(time.time() - self.start_unix, 3),
+            "lanes": {
+                "total": total,
+                "active": active,
+                "free": total - active,
+            },
+            "queue_depth": queued,
+            "cache_epoch": self.engine.cache_epoch,
+        }
+        reasons = self.degraded_reasons()
+        wd = self.watchdog
+        if wd is not None and wd.degraded:
+            payload["watchdog"] = wd.status()
+        if self.anomaly.degraded:
+            payload["anomaly"] = self.anomaly.status()
+        if reasons:
+            # a degraded engine is still accepting connections — health
+            # says so, so a probe/router can act on it
+            payload["status"] = "degraded"
+            payload["degraded_reasons"] = reasons
+        if self.draining:
+            # draining wins: routers must stop sending traffic regardless
+            # of how healthy the engine itself looks
+            payload["status"] = "draining"
+            payload["draining_since_unix"] = self.draining_since
+        return payload
+
+    def _series_context(self) -> dict:
+        from ..obs.anomaly import DEFAULT_SIGNAL_SERIES
+
+        out = {}
+        for name in DEFAULT_SIGNAL_SERIES:
+            q = self.series.query(name, 60.0)
+            if q is not None:
+                out[name] = q
+        return out
+
+    def admission_decision(self, priority: str) -> tuple[str, int] | None:
+        """Load-shedding gate, consulted by the handler BEFORE a request
+        touches the scheduler queue. None admits; otherwise returns
+        (reason, retry_after_s) and the handler refuses with 429/503 +
+        Retry-After. The priority ladder sheds lowest first: a "low"
+        request is refused at half the queue threshold and whenever the
+        engine is degraded; "high" rides out twice the threshold."""
+        if self.draining:
+            return ("draining", 5)
+        sched = self.scheduler
+        if sched is not None and self.max_queue_depth > 0:
+            factor = {"low": 0.5, "high": 2.0}.get(priority, 1.0)
+            if len(sched.pending) >= self.max_queue_depth * factor:
+                return ("queue_full", 1)
+        if priority == "low" and self.degraded_reasons():
+            return ("degraded", 2)
+        return None
+
+    def begin_drain(self) -> dict:
+        """Start a graceful drain (POST /v1/drain, SIGTERM): admission
+        flips to shedding, in-flight streams run to completion, then the
+        span/trace sinks flush and ``drained`` is set. Idempotent."""
+        sched = self.scheduler
+        if sched is not None:
+            in_flight = (
+                sum(1 for ls in sched.lanes if ls is not None)
+                + len(sched.admitting)
+                + len(sched.pending)
+            )
+        else:
+            in_flight = 1 if self.lock.locked() else 0
+        if not self.draining:
+            self.draining = True
+            self.draining_since = time.time()
+            self.g_draining.set(1)
+            self.recorder.record("drain_begin", in_flight=in_flight)
+            t = threading.Thread(  # dlint: disable=thread-hygiene — the drained event is the join surface; the process exits after it fires
+                target=self._drain_watch, daemon=True, name="dllama-drain"
+            )
+            t.start()
+        return {
+            "status": "draining",
+            "in_flight": in_flight,
+            "since_unix": self.draining_since,
+        }
+
+    def _drain_watch(self) -> None:
+        """Poll until every in-flight request finished, then flush the
+        observability sinks and signal ``drained`` (the SIGTERM handler
+        waits on it before shutting the HTTP server down)."""
+        sched = self.scheduler
+        while True:
+            if sched is not None:
+                idle = (
+                    not any(sched.lanes)
+                    and not sched.admitting
+                    and not sched.pending
+                )
+            else:
+                idle = not self.lock.locked()
+            if idle:
+                break
+            time.sleep(0.05)
+        self.spans.flush()
+        self.recorder.record("drain_complete")
+        self.drained.set()
 
     # -- completion ------------------------------------------------------
 
@@ -1481,6 +1885,7 @@ _KNOWN_PATHS = frozenset(
         "/v1/debug/slo",
         "/v1/debug/series",
         "/v1/debug/profile",
+        "/v1/drain",
         "/dashboard",
         "/metrics",
         "/health",
@@ -1504,12 +1909,18 @@ def make_handler(state: ApiState):
         def log_message(self, fmt, *args):  # quiet access log
             pass
 
-        def _json(self, payload: dict, status: int = 200) -> None:
+        def _json(
+            self, payload: dict, status: int = 200,
+            retry_after: int | None = None,
+        ) -> None:
             body = json.dumps(payload).encode("utf-8")
             self.send_response(status)
             self.send_header("Access-Control-Allow-Origin", "*")
             self.send_header("Content-Type", "application/json; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
+            if retry_after is not None:
+                # shed/drain refusals tell the client when to come back
+                self.send_header("Retry-After", str(retry_after))
             self.end_headers()
             self.wfile.write(body)
 
@@ -1556,52 +1967,9 @@ def make_handler(state: ApiState):
                 self.end_headers()
                 self.wfile.write(body)
             elif path == "/v1/health":
-                sched = state.scheduler
-                total = state.engine.batch_size if sched is not None else 1
-                if sched is not None:
-                    with sched.cv:
-                        active = sum(
-                            1 for ls in sched.lanes if ls is not None
-                        )
-                        queued = len(sched.pending)
-                else:
-                    active = 1 if state.lock.locked() else 0
-                    queued = 0
-                payload = {
-                    "status": "ok",
-                    "model": state.model_name,
-                    "uptime_s": round(time.time() - state.start_unix, 3),
-                    "lanes": {
-                        "total": total,
-                        "active": active,
-                        "free": total - active,
-                    },
-                    "queue_depth": queued,
-                    "cache_epoch": state.engine.cache_epoch,
-                }
-                # degraded status COMPOSES: the watchdog (hard stall) and
-                # the anomaly monitor (soft baseline deviation) each
-                # contribute reasons — never last-writer-wins
-                degraded_reasons: list[str] = []
-                wd = state.watchdog
-                if wd is not None and wd.degraded:
-                    wd_status = wd.status()
-                    payload["watchdog"] = wd_status
-                    degraded_reasons.append(
-                        f"watchdog:{wd_status.get('reason')}"
-                    )
-                if state.anomaly.degraded:
-                    payload["anomaly"] = state.anomaly.status()
-                    degraded_reasons.extend(
-                        f"anomaly:{s}"
-                        for s in state.anomaly.active_signals()
-                    )
-                if degraded_reasons:
-                    # a degraded engine is still accepting connections —
-                    # health says so, so a probe/router can act on it
-                    payload["status"] = "degraded"
-                    payload["degraded_reasons"] = degraded_reasons
-                self._json(payload)
+                # composed status (ok/degraded/draining) — the same
+                # snapshot postmortem dumps embed (ApiState.health_snapshot)
+                self._json(state.health_snapshot())
             elif path == "/v1/debug/recorder":
                 # the engine flight recorder's ring: the last N
                 # dispatches/compiles/epochs/scheduler decisions
@@ -1704,6 +2072,11 @@ def make_handler(state: ApiState):
             if path == "/v1/debug/profile":
                 self._profile()
                 return
+            if path == "/v1/drain":
+                # graceful drain: stop admission, finish in-flight
+                # streams, flush sinks, flip /v1/health to "draining"
+                self._json(state.begin_drain())
+                return
             if path != "/v1/chat/completions":
                 self.send_error(404, "Not Found")
                 return
@@ -1713,6 +2086,29 @@ def make_handler(state: ApiState):
                 params = self._parse_params(body)
             except (ValueError, KeyError, TypeError) as e:
                 self._json({"error": {"message": f"bad request: {e}"}}, 400)
+                return
+
+            # load shedding BEFORE the request touches the queue or the
+            # engine lock: a refused request costs the server nothing
+            shed = state.admission_decision(params.priority)
+            if shed is not None:
+                reason, retry_after = shed
+                state.m_shed.labels(reason=reason).inc()
+                state.recorder.record(
+                    "request_shed", reason=reason,
+                    priority=params.priority, retry_after_s=retry_after,
+                )
+                self._json(
+                    {
+                        "error": {
+                            "message": f"request shed: {reason}",
+                            "retryable": True,
+                            "retry_after_s": retry_after,
+                        }
+                    },
+                    503 if reason == "draining" else 429,
+                    retry_after=retry_after,
+                )
                 return
 
             if state.scheduler is not None:
@@ -1819,15 +2215,27 @@ def make_handler(state: ApiState):
                                 request_id=job.span.request_id,
                                 lane=job.span.lane,
                             ):
+                                # chaos site: a mid-stream client death is
+                                # indistinguishable from a flush failure,
+                                # so inject it AS one (exercises the
+                                # cancel path below)
+                                fault = get_fault_plane().draw("sse_flush")
+                                if fault is not None:
+                                    raise OSError(str(fault))
                                 _sse_write(
                                     self.wfile,
                                     f"data: {json.dumps(chunk)}\r\n\r\n",
                                 )
                         elif kind == "error":
+                            err = (
+                                payload
+                                if isinstance(payload, dict)
+                                else {"message": str(payload)}
+                            )
                             _sse_write(
                                 self.wfile,
                                 "data: "
-                                + json.dumps({"error": {"message": payload}})
+                                + json.dumps({"error": err})
                                 + "\r\n\r\n",
                             )
                             errored = True
@@ -1855,7 +2263,19 @@ def make_handler(state: ApiState):
             while True:
                 kind, payload = job.events.get()
                 if kind == "error":
-                    self._json({"error": {"message": payload}}, 500)
+                    err = (
+                        payload
+                        if isinstance(payload, dict)
+                        else {"message": str(payload)}
+                    )
+                    # a retryable failure (engine fault, not the client's
+                    # request) answers 503 + Retry-After; validation
+                    # errors keep their 500
+                    self._json(
+                        {"error": err},
+                        503 if err.get("retryable") else 500,
+                        retry_after=1 if err.get("retryable") else None,
+                    )
                     return
                 if kind == "done":
                     finish_reason = payload
@@ -1936,6 +2356,11 @@ def make_handler(state: ApiState):
                 stop = body["stop"]
                 # OpenAI allows a bare string or a list of strings
                 params.stop = [stop] if isinstance(stop, str) else [str(x) for x in stop]
+            if "priority" in body:
+                priority = str(body["priority"])
+                if priority not in ("low", "normal", "high"):
+                    raise ValueError(f"unknown priority {priority!r}")
+                params.priority = priority
             return params
 
     return Handler
@@ -1960,10 +2385,21 @@ def serve(
     series_retention: float | None = None,
     speculation: str | None = None,
     spec_k: int | None = None,
+    retry_max: int | None = None,
+    retry_backoff_ms: int | None = None,
+    max_queue_depth: int | None = None,
+    faults: str | None = None,
 ):
     block, chunk = resolve_lane_knobs(lane_block_size, admission_chunk)
     page_size, pool_pages = resolve_kv_knobs(kv_page_size, kv_pool_pages)
     spec_mode, spec_k_val = resolve_spec_knobs(speculation, spec_k)
+    r_max, r_backoff, q_depth = resolve_resilience_knobs(
+        retry_max, retry_backoff_ms, max_queue_depth
+    )
+    if faults is not None:
+        # arm the process-wide chaos plane for this server's lifetime
+        # (--faults; the env spec DLLAMA_FAULTS armed it at import)
+        set_fault_plane(faults)
     state = ApiState(
         engine,
         tokenizer,
@@ -1979,6 +2415,9 @@ def serve(
         series_retention=series_retention,
         speculation=spec_mode,
         spec_k=spec_k_val,
+        retry_max=r_max,
+        retry_backoff_ms=r_backoff,
+        max_queue_depth=q_depth,
     )
     if postmortem_dir:
         # a crashed scheduler loop / engine step dumps the event ring here
@@ -2007,6 +2446,31 @@ def serve(
     if host in ("0.0.0.0", "127.0.0.1"):
         print(f"Server URL: http://localhost:{port}/v1/")
     return server  # caller runs serve_forever() (tests drive it in a thread)
+
+
+def _install_drain_handler(server) -> None:
+    """SIGTERM = graceful drain (the rolling-restart primitive a replica
+    router relies on): stop admission, let in-flight streams finish (60 s
+    cap), flush sinks, then shut the HTTP server down. Signal handlers
+    only install from the main thread; anywhere else (tests driving
+    main() in a worker) this is a no-op."""
+    import signal
+
+    def _on_term(signum, frame):
+        server.state.begin_drain()
+
+        def _wait_and_stop():
+            server.state.drained.wait(timeout=60.0)
+            server.shutdown()
+
+        threading.Thread(  # dlint: disable=thread-hygiene — process is exiting; server.shutdown() is the terminal act
+            target=_wait_and_stop, daemon=True, name="dllama-drain-stop"
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass
 
 
 def main(argv=None) -> None:
@@ -2063,7 +2527,12 @@ def main(argv=None) -> None:
                 series_retention=args.series_retention,
                 speculation=args.speculation,
                 spec_k=args.spec_k,
+                retry_max=args.retry_max,
+                retry_backoff_ms=args.retry_backoff_ms,
+                max_queue_depth=args.max_queue_depth,
+                faults=args.faults,
             )
+            _install_drain_handler(server)
             server.serve_forever()
             return
         except KeyboardInterrupt:

@@ -28,6 +28,7 @@ from ..formats.quants import FloatType
 from ..models import forward, init_kv_cache, load_params
 from ..parallel import cache_specs, make_mesh, shard_params_put, validate_tp
 from ..tokenizer import Tokenizer
+from .faults import get_fault_plane
 from .sampler import Sampler
 
 # Prefill chunk buckets: one compiled program per bucket (the reference's
@@ -546,6 +547,14 @@ class InferenceEngine:
                 raise rebuild_err from e
             raise
 
+    def _fault(self, op: str):
+        """Chaos hook (runtime/faults.py): the armed fault for this
+        dispatch, if any. Callers raise a TRANSIENT fault BEFORE their
+        donated-buffer guard (buffers intact, the epoch does not move,
+        the scheduler retries) and a POISON fault INSIDE it (the guard
+        rebuilds the buffer and the epoch moves — the recovery path)."""
+        return get_fault_plane().draw("dispatch", op=op)
+
     def set_seed(self, seed: int) -> None:
         """Reseed BOTH sampling paths (host xorshift sampler and the
         on-device PRNG used by blocked decode)."""
@@ -759,6 +768,9 @@ class InferenceEngine:
 
         def work():
             try:
+                fault = get_fault_plane().draw("prefetch")
+                if fault is not None:
+                    raise fault
                 builder()
             except Exception:
                 # a daemon thread dies silently by default: the boundary
@@ -1166,6 +1178,9 @@ class InferenceEngine:
                 f"{n} fill tokens at pos {pos0} exceed "
                 f"seqLen {self.header.seq_len}"
             )
+        fault = self._fault("prefill_lane_chunk")
+        if fault is not None and not fault.poison:
+            raise fault
         want = min(n, budget) if budget and budget > 0 else n
         bucket = self._bucket_for(want, pos0)
         width = min(bucket, want)
@@ -1190,6 +1205,8 @@ class InferenceEngine:
         )
         pos_arr = jnp.asarray(posv, jnp.int32)
         with self._cache_guard():
+            if fault is not None:
+                raise fault
             self.cache = step(self.params, arr, self.cache, pos_arr)
         dt = time.perf_counter() - t0
         self._spans.end(sp)
@@ -1440,6 +1457,9 @@ class InferenceEngine:
             raise ValueError("empty page list")
         if n * self._kv_page_size > self.header.seq_len:
             raise ValueError(f"{n} pages exceed seqLen {self.header.seq_len}")
+        fault = self._fault("kv_adopt")
+        if fault is not None and not fault.poison:
+            raise fault
         self.recorder.record(
             "step_dispatch", step="kv_adopt", lane=lane, n_pages=n
         )
@@ -1451,6 +1471,8 @@ class InferenceEngine:
             fn = self._kv_copy_fn("adopt", bucket)
             ids = jnp.asarray(page_ids[start : start + bucket], jnp.int32)
             with self._cache_guard():
+                if fault is not None:
+                    raise fault
                 self.cache = fn(
                     self.cache, self.kv_pool,
                     jnp.int32(lane), jnp.int32(start), ids,
@@ -1482,6 +1504,9 @@ class InferenceEngine:
                 f"pages [{start_page}, {start_page + n}) exceed "
                 f"seqLen {self.header.seq_len}"
             )
+        fault = self._fault("kv_publish")
+        if fault is not None and not fault.poison:
+            raise fault
         self.recorder.record(
             "step_dispatch", step="kv_publish", lane=lane, n_pages=n,
             start_page=start_page,
@@ -1495,6 +1520,8 @@ class InferenceEngine:
             fn = self._kv_copy_fn("publish", bucket)
             ids = jnp.asarray(page_ids[off : off + bucket], jnp.int32)
             with self._kv_pool_guard():
+                if fault is not None:
+                    raise fault
                 self.kv_pool = fn(
                     self.cache, self.kv_pool,
                     jnp.int32(lane), jnp.int32(start_page + off), ids,
@@ -1683,6 +1710,9 @@ class InferenceEngine:
              ) & 0x7FFFFFFF
             for i, s in enumerate(seeds or [None] * self.batch_size)
         ]
+        fault = self._fault("decode_lanes")
+        if fault is not None and not fault.poison:
+            raise fault
         self.recorder.record(
             "step_dispatch", step="decode_lanes", pos=deepest,
             n_steps=n_steps, window=window, n_live=len(live),
@@ -1693,6 +1723,8 @@ class InferenceEngine:
         )
         t0 = time.perf_counter()
         with self._cache_guard():
+            if fault is not None:
+                raise fault
             out, self.cache = block(
                 self.params,
                 arr,
@@ -1868,6 +1900,9 @@ class InferenceEngine:
         )
         pos_arr = jnp.asarray(pos, jnp.int32)
         act_arr = jnp.asarray(active, jnp.bool_)
+        fault = self._fault("verify_lanes")
+        if fault is not None and not fault.poison:
+            raise fault
         self.recorder.record(
             "step_dispatch", step="verify_lanes", pos=deepest,
             t=t, window=window, n_live=len(live),
@@ -1878,6 +1913,8 @@ class InferenceEngine:
         )
         t0 = time.perf_counter()
         with self._cache_guard():
+            if fault is not None:
+                raise fault
             out, self.cache = vstep(
                 self.params, arr, self.cache, pos_arr, act_arr
             )

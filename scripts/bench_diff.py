@@ -42,6 +42,10 @@ WATCHED: dict[str, str] = {
     "DECODE.step_ms.p50": "lower",
     "TTFT.ttft_ms_p50": "lower",
     "SERVING.ttft_ms_p50": "lower",
+    # the recovery tax: how long a poisoned lane's client stalls while
+    # its history re-prefills (ISSUE 12; generous threshold headroom is
+    # the --threshold flag's job, not this table's)
+    "SERVING.resilience.p99_gap_ms_recovery": "lower",
 }
 
 

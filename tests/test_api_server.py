@@ -837,10 +837,13 @@ def test_cross_lane_radix_reuse_and_kv_debug(obs_server):
 def test_scheduler_error_counter(obs_server):
     """An engine error inside the scheduler loop is counted (satellite:
     the loop used to swallow these silently), the in-flight request gets
-    a 500, and the server keeps serving."""
+    a structured retryable 503 + Retry-After (PR 12: the cache epoch
+    never moved, so this is a transient-class failure the client should
+    simply retry), and the server keeps serving."""
     state = obs_server.state
     engine = state.engine
     b_err = state.m_sched_errors.value
+    b_retry = state.m_dispatch_retries.value
     real = engine.decode_lanes
 
     def boom(*a, **k):
@@ -853,11 +856,16 @@ def test_scheduler_error_counter(obs_server):
                 "messages": [{"role": "user", "content": "doomed"}],
                 "max_tokens": 4, "temperature": 0,
             }).read()
-        assert exc.value.code == 500
-        assert "injected" in json.loads(exc.value.read())["error"]["message"]
+        assert exc.value.code == 503
+        assert exc.value.headers.get("Retry-After") is not None
+        err = json.loads(exc.value.read())["error"]
+        assert "injected" in err["message"]
+        assert err["retryable"] is True
     finally:
         engine.decode_lanes = real
     assert state.m_sched_errors.value == b_err + 1
+    # the deterministic failure was retried with backoff before the drop
+    assert state.m_dispatch_retries.value == b_retry + state.retry_max
     # scheduler thread survived: the next request completes normally
     with _post(_url(obs_server), {
         "messages": [{"role": "user", "content": "still alive?"}],
@@ -980,7 +988,7 @@ def test_scheduler_error_writes_postmortem(obs_server, tmp_path):
                 "messages": [{"role": "user", "content": "doomed again"}],
                 "max_tokens": 4, "temperature": 0,
             }).read()
-        assert exc.value.code == 500
+        assert exc.value.code == 503
     finally:
         engine.decode_lanes = real
         state.recorder.postmortem_dir = old_dir
@@ -994,6 +1002,13 @@ def test_scheduler_error_writes_postmortem(obs_server, tmp_path):
     kinds = [e["kind"] for e in payload["events"]]
     assert "scheduler_error" in kinds  # the ring captured the failure
     assert "step_dispatch" in kinds    # ...and the engine history before it
+    # PR 12 satellite: the dump embeds the server-level evidence — a
+    # /v1/health snapshot and the trailing anomaly-signal series — so a
+    # ring file is diagnosable without the live server
+    ctx = payload["context"]
+    assert ctx["health"]["model"] == state.model_name
+    assert "lanes" in ctx["health"] and "cache_epoch" in ctx["health"]
+    assert isinstance(ctx["series_60s"], dict)
     # the loop survived: a normal request completes and the dump shows it
     with _post(_url(obs_server), {
         "messages": [{"role": "user", "content": "recovered?"}],
