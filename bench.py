@@ -904,6 +904,108 @@ def _serving_smoke(n_clients: int) -> dict:
         "requests_shed": n_shed,
     }
 
+    # oversubscription (ISSUE 16): 2 decode lanes serving 4 concurrent
+    # streams via park/resume through the pool-native paged-KV path; the
+    # slab paged server running the identical workload is the baseline
+    # for TPOT and for KV copy traffic (slab moves bytes on every
+    # adopt/publish, pool-native only on COW boundary forks)
+    def over_round(port_, n_streams, max_tokens=40):
+        """n_streams concurrent greedy streams: (n completed, per-stream
+        TPOT ms from SSE arrival deltas)."""
+        tpots: list = [None] * n_streams
+        done = [False] * n_streams
+
+        def one(i: int) -> None:
+            arrivals: list[float] = []
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port_, timeout=300
+            )
+            conn.request(
+                "POST", "/v1/chat/completions",
+                json.dumps({
+                    "messages": [
+                        {"role": "user",
+                         "content": f"oversubscribed stream {i}"}
+                    ],
+                    "max_tokens": max_tokens, "stream": True,
+                    "temperature": 0.0,
+                }),
+                {"Content-Type": "application/json"},
+            )
+            r = conn.getresponse()
+            while True:
+                line = r.readline()
+                if not line or b"[DONE]" in line:
+                    break
+                if line.startswith(b"data:"):
+                    arrivals.append(time.perf_counter())
+            conn.close()
+            done[i] = bool(arrivals)
+            if len(arrivals) > 1:
+                tpots[i] = (
+                    (arrivals[-1] - arrivals[0]) / (len(arrivals) - 1) * 1000
+                )
+
+        ths = [
+            threading.Thread(
+                target=one, args=(i,), daemon=True,
+                name=f"dllama-bench-over-{i}",
+            )
+            for i in range(n_streams)
+        ]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        return sum(done), sorted(t for t in tpots if t is not None)
+
+    def over_server(native: bool):
+        eng = InferenceEngine(
+            model_path, tokenizer=tok, batch_size=2, temperature=0.0
+        )
+        srv_ = serve(
+            eng, tok, host="127.0.0.1", port=0, admission_chunk=32,
+            kv_page_size=4, kv_native=native, max_streams=4,
+        )
+        threading.Thread(  # dlint: disable=thread-hygiene — serve_forever exits at srv_.shutdown() below; no handle needed
+            target=srv_.serve_forever, daemon=True,
+            name=f"dllama-bench-http-over-{'native' if native else 'slab'}",
+        ).start()
+        port_ = srv_.server_address[1]
+        over_round(port_, 2, max_tokens=8)  # warm: compiles + publishes
+        pre = scrape_port(port_)
+        n_done, tpots_ = over_round(port_, 4)
+        post = scrape_port(port_)
+        srv_.shutdown()
+        return n_done, tpots_, pre, post
+
+    over_done, over_tpots, pre_over, post_over = over_server(native=True)
+    slab_done, slab_tpots, pre_slab, post_slab = over_server(native=False)
+
+    def p50(xs):
+        return round(xs[len(xs) // 2], 2) if xs else None
+
+    oversubscription = {
+        "streams": 4,
+        "lanes": 2,
+        "completed": int(over_done),
+        "stream_resumes": int(
+            metric_value(post_over, "dllama_stream_resumes_total")
+            - metric_value(pre_over, "dllama_stream_resumes_total")
+        ),
+        "tpot_ms_p50": p50(over_tpots),
+        "tpot_ms_p50_slab": p50(slab_tpots),
+        "completed_slab": int(slab_done),
+        "kv_copy_bytes_native": int(
+            metric_value(post_over, "dllama_kv_copy_bytes_total")
+            - metric_value(pre_over, "dllama_kv_copy_bytes_total")
+        ),
+        "kv_copy_bytes_slab": int(
+            metric_value(post_slab, "dllama_kv_copy_bytes_total")
+            - metric_value(pre_slab, "dllama_kv_copy_bytes_total")
+        ),
+    }
+
     return {
         "n_clients": n_clients,
         "n_traced": len(recs),
@@ -924,6 +1026,7 @@ def _serving_smoke(n_clients: int) -> dict:
         "prefix_fanout": prefix_fanout,
         "speculation": speculation,
         "resilience": resilience,
+        "oversubscription": oversubscription,
         "slo": slo,
         "timeline": timeline,
         "series": series,

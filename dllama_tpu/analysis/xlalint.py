@@ -211,7 +211,7 @@ def _key_steps_tokens(key: Any, batch: int) -> tuple[int, int]:
     if isinstance(key, tuple) and key and isinstance(key[0], str):
         kind = key[0]
         n = int(key[1]) if len(key) > 1 else 1
-        if kind in ("block", "lane_block"):
+        if kind in ("block", "lane_block", "lane_block_paged"):
             return n, batch
         # lane_prefill / lane_verify / score / kv_*: one forward, n wide
         return 1, n * batch
@@ -263,6 +263,7 @@ def engine_policies(engine: "InferenceEngine") -> dict:
         "score": fwd,
         "kv_adopt": copy,
         "kv_publish": copy,
+        "kv_page_copy": copy,
     }
 
 
@@ -291,6 +292,15 @@ def _engine_program(
         else 0
     )
     steps, tokens = _key_steps_tokens(key, engine.batch_size)
+    # pool-native lane programs (PR 16) share their family with the
+    # slab variants but donate the POOL, not the lane cache, and pay
+    # page-indirection traffic the ceiling must cover
+    paged = (
+        isinstance(key, tuple)
+        and bool(key)
+        and isinstance(key[0], str)
+        and key[0].endswith("_paged")
+    )
     ceilings = program_cost_ceilings(
         family,
         steps=steps,
@@ -300,8 +310,9 @@ def _engine_program(
         pool_bytes=pool_b,
         param_elems=_tree_elems(engine._param_specs),
         cache_elems=_tree_elems(engine._cache_specs),
+        paged=paged,
     )
-    if family == "kv_publish":
+    if paged or family in ("kv_publish", "kv_page_copy"):
         expected = (
             _tree_nleaves(engine._kv_pool_specs)
             if engine._kv_pool_specs is not None
@@ -512,6 +523,11 @@ def build_cli_engine() -> "InferenceEngine":
         prefill_buckets=(1, 8, 32),
     )
     engine.init_kv_pool(page_size=8)
+    engine.rehearse_admission(block_size=8, spec_k=2, wait=True)
+    # pool-native paged families (PR 16): flip native on and rehearse
+    # again — the compile cache keeps the slab programs, so BOTH KV
+    # paths' executables go under the lint in one run
+    engine.init_kv_pool(page_size=8, native=True)
     engine.rehearse_admission(block_size=8, spec_k=2, wait=True)
     return engine
 

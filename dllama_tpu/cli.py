@@ -64,6 +64,27 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                    help="pages in the shared KV pool (default: env "
                         "DLLAMA_KV_POOL_PAGES, else auto: two sequences' "
                         "worth, 2*seqLen/pageSize + 1)")
+    p.add_argument("--kv-native", type=int, default=None,
+                   dest="kv_native", metavar="0|1",
+                   help="pool-native paged decode on the lane path: "
+                        "lanes read/write KV through a per-lane page "
+                        "table straight into the shared pool, so prefix "
+                        "adoption is a refcount bump (zero device-copy "
+                        "bytes on page-aligned matches) and publish an "
+                        "ownership transfer (default: env "
+                        "DLLAMA_KV_NATIVE, else 0 = per-lane slab KV "
+                        "with adopt/publish page copies); requires "
+                        "pp=1 and sp=1")
+    p.add_argument("--max-streams", type=int, default=None,
+                   dest="max_streams", metavar="N",
+                   help="concurrent streams the scheduler may admit, "
+                        "oversubscribing the decode lanes: when N > "
+                        "batch-size and requests queue, the "
+                        "most-progressed lane parks (KV published to "
+                        "the shared pool, page list dropped) and the "
+                        "parked stream later resumes via radix "
+                        "re-match (default: env DLLAMA_MAX_STREAMS, "
+                        "else 0 = streams cap at the lane count)")
     p.add_argument("--admission-chunk", type=int, default=None,
                    dest="admission_chunk", metavar="TOKENS",
                    help="max prompt tokens prefilled per scheduler tick "

@@ -47,10 +47,16 @@ class PagedKVManager:
         page_size: int = 0,
         n_pages: int = 0,
         evict_counter: Any = None,
+        native: bool = False,
     ) -> None:
         self.engine = engine
         self.page_size = page_size or DEFAULT_PAGE_SIZE
-        n = engine.init_kv_pool(self.page_size, n_pages)
+        # native mode (ISSUE 16): the pool is the lanes' only KV home —
+        # adopt becomes refcount bumps + a page-table write (device copy
+        # only for a COW mid-page boundary) and publish becomes ownership
+        # transfer of pages the lane already wrote
+        self.native = bool(native)
+        n = engine.init_kv_pool(self.page_size, n_pages, native=self.native)
         self.recorder = get_recorder()
         # component="kv" spans over the host-side accounting (the engine's
         # device copies inside adopt/publish record their own spans)
@@ -59,6 +65,7 @@ class PagedKVManager:
         self.tree = RadixTree(self.page_size)
         self.lock = make_lock("kv.manager")
         self._lane_pages: dict[int, list[int]] = {}
+        self._lane_match_tokens: dict[int, int] = {}
         # dashboards keep their dllama_cache_evictions_total series: the
         # ApiState hands us its handle and radix evictions feed it
         self._evict_counter = evict_counter
@@ -137,25 +144,91 @@ class PagedKVManager:
             mr = self.tree.match(tokens)
             m = min(mr.n_tokens, len(mr.pages) * ps, len(tokens) - 1)
             if m <= 0:
+                self._lane_match_tokens[lane] = 0
                 self._update_gauges_locked()
                 return 0, []
             n_pages = -(-m // ps)  # ceil
             pages = mr.pages[:n_pages]
             self.pool.retain(pages)
             self._lane_pages[lane] = list(pages)
+            self._lane_match_tokens[lane] = m
             self._update_gauges_locked()
             return m, pages
 
     def adopt(self, lane: int, pages: list[int]) -> None:
-        """Device-copy ``pages`` (already retained by :meth:`match`)
-        into ``lane``'s slab."""
-        self.engine.kv_adopt(lane, pages)
+        """Slab mode: device-copy ``pages`` (already retained by
+        :meth:`match`) into ``lane``'s slab. Native mode: build the lane's
+        full page list — the shared prefix pages as-is, a COW fork of a
+        mid-page boundary (the only device copy), and freshly allocated
+        private pages for everything the lane will write — and point the
+        engine's page table at it. A full-page prefix match therefore
+        moves ZERO device bytes."""
+        if not self.native:
+            if pages:
+                self.engine.kv_adopt(lane, pages)
+            return
+        self._adopt_native(lane, pages)
+
+    def _adopt_native(self, lane: int, pages: list[int]) -> None:
+        ps = self.page_size
+        n_blocks = self.engine._kv_n_blocks
+        with self.spans.span(
+            "kv_adopt_native", component="kv", lane=lane, n_pages=len(pages)
+        ), self.lock:
+            fault = get_fault_plane().draw("kv_alloc", op="adopt")
+            if fault is not None:
+                raise fault
+            m = self._lane_match_tokens.get(lane, 0)
+            lane_list = list(pages)
+            if m % ps and lane_list:
+                # mid-page boundary: the lane will scatter rows >= m into
+                # this slot, so it needs a private copy of the shared page
+                orig = lane_list[-1]
+                fork = self._alloc_lane_pages(1, lane, fork_src=orig)[0]
+                try:
+                    self.engine.kv_page_copy([orig], [fork])
+                except BaseException:
+                    self.pool.release([fork])
+                    raise
+                # swap the lane's retain from the shared original to the
+                # private fork (the tree keeps its own ref on the original)
+                self.pool.release([orig])
+                lane_list[-1] = fork
+            need = n_blocks - len(lane_list)
+            if need > 0:
+                lane_list += self._alloc_lane_pages(need, lane)
+            self._lane_pages[lane] = lane_list
+            self.engine.adopt_pages(lane, lane_list)
+            self._update_gauges_locked()
+
+    def _alloc_lane_pages(
+        self, n: int, lane: int, fork_src: int | None = None
+    ) -> list[int]:
+        """Allocate ``n`` private pages for a native lane, LRU-evicting
+        refcount-1 tree leaves under pressure. Unlike the publish path a
+        shortfall here RAISES (MemoryError): admission cannot proceed
+        without somewhere to write, and the scheduler's retry/fail path
+        already handles a transient adopt failure."""
+        short = n - self.pool.free_pages
+        if short > 0:
+            freed = self.tree.evict(short, self.pool)  # dlint: disable=guarded-attrs — only called from _adopt_native, under self.lock
+            self.c_evictions.inc(freed)
+            if self._evict_counter is not None:
+                self._evict_counter.inc(freed)
+            if freed:
+                self.recorder.record("kv_evict", n_pages=freed, lane=lane)
+        if fork_src is not None:
+            return [self.pool.fork(fork_src)]
+        return self.pool.alloc(n)
 
     def release_lane(self, lane: int) -> None:
         with self.lock:
             pages = self._lane_pages.pop(lane, None)
+            self._lane_match_tokens.pop(lane, None)
             if pages:
                 self.pool.release(pages)
+            if self.native:
+                self.engine.clear_lane_pages(lane)
             self._update_gauges_locked()
 
     # -- finish ------------------------------------------------------------
@@ -173,6 +246,8 @@ class PagedKVManager:
             return self._publish(lane, tokens)
 
     def _publish(self, lane: int, tokens: list[int]) -> int:
+        if self.native:
+            return self._publish_native(lane, tokens)
         ps = self.page_size
         n_full = len(tokens) // ps
         if n_full == 0:
@@ -220,14 +295,27 @@ class PagedKVManager:
                 self.pool.release(mr.pages)
             if pages is None:
                 return 0
+        pool_epoch0 = getattr(self.engine, "kv_pool_epoch", 0)
         try:
             self.engine.kv_publish(lane, pages, start_page=k_shared)
         except BaseException:
-            # the publish program donates the pool buffer: device contents
-            # are unknown, so drop ALL host accounting with it (the engine
-            # guard already rebuilt the buffer)
-            logger.exception("kv_publish failed; resetting the page pool")
-            self.reset(reset_device=False)
+            if getattr(self.engine, "kv_pool_epoch", 0) != pool_epoch0:
+                # the publish program donated the pool buffer and the
+                # engine guard rebuilt it: EVERY page's device contents
+                # are gone, so drop all host accounting with them
+                logger.exception("kv_publish poisoned the pool; resetting")
+                self.reset(reset_device=False)
+            else:
+                # transient failure before the buffer was touched (e.g.
+                # an injected dispatch fault): only this publish's fresh
+                # pages are suspect — release them and keep every
+                # survivor's pages and the stored prefixes intact
+                logger.exception(
+                    "kv_publish failed; dropping this publish's pages"
+                )
+                with self.lock:
+                    self.pool.release(pages)
+                    self._update_gauges_locked()
             return 0
         with self.lock:
             try:
@@ -237,6 +325,50 @@ class PagedKVManager:
                 # stored path; a rejection means the accounting raced —
                 # drop the new pages and skip the store instead of
                 # crashing the scheduler (only future reuse is lost)
+                logger.exception("kv radix insert rejected; publish dropped")
+                self.pool.release(pages)
+                self._update_gauges_locked()
+                return 0
+            self._update_gauges_locked()
+        return n_new
+
+    def _publish_native(self, lane: int, tokens: list[int]) -> int:
+        """Native publish = ownership transfer, zero device work: the
+        lane already WROTE its KV into its private pool pages, so storing
+        a prefix means retaining those pages for the tree and inserting
+        the token path. Dedup still applies: slots the tree already holds
+        keep the tree's pages (the lane's duplicates are freed at
+        release_lane)."""
+        ps = self.page_size
+        n_full = len(tokens) // ps
+        if n_full == 0:
+            return 0
+        full = list(tokens[: n_full * ps])
+        fault = get_fault_plane().draw("dispatch", op="kv_publish")
+        if fault is not None:
+            # degraded-not-dead, same policy as the slab skip paths: the
+            # stream already served, only future reuse is lost
+            self.recorder.record(
+                "kv_publish_skipped", lane=lane, want=n_full, error=str(fault)
+            )
+            return 0
+        with self.lock:
+            lane_list = self._lane_pages.get(lane) or []
+            if len(lane_list) < n_full:
+                return 0
+            mr = self.tree.match(full)
+            k_shared = min(mr.n_tokens // ps, n_full)
+            n_new = n_full - k_shared
+            if n_new == 0:
+                return 0
+            pages = lane_list[k_shared:n_full]
+            # the tree must own its own reference BEFORE insert: the
+            # lane's retain dies with release_lane, and a tree pointing
+            # at freed pages would hand later admissions recycled KV
+            self.pool.retain(pages)
+            try:
+                self.tree.insert(full, pages, first_slot=k_shared)
+            except Exception:
                 logger.exception("kv radix insert rejected; publish dropped")
                 self.pool.release(pages)
                 self._update_gauges_locked()
@@ -284,6 +416,9 @@ class PagedKVManager:
             self.tree.clear()
             self.pool.reset()
             self._lane_pages.clear()
+            self._lane_match_tokens.clear()
+            if self.native:
+                self.engine.clear_all_lane_pages()
             self._update_gauges_locked()
         if reset_device:
             self.engine.reset_kv_pool()
@@ -297,6 +432,9 @@ class PagedKVManager:
             for pages in self._lane_pages.values():
                 self.pool.release(pages)
             self._lane_pages.clear()
+            self._lane_match_tokens.clear()
+            if self.native:
+                self.engine.clear_all_lane_pages()
             self._update_gauges_locked()
 
     def check(self) -> None:

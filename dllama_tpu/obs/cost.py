@@ -132,6 +132,7 @@ def program_cost_ceilings(
     param_elems: float = 0.0,
     cache_elems: float = 0.0,
     slack: float = 8.0,
+    paged: bool = False,
 ) -> dict:
     """Per-program {bytes_accessed, flops} ceilings for the xlalint cost
     budget gate, derived from the same roofline model as
@@ -142,12 +143,17 @@ def program_cost_ceilings(
     guards, not tight bounds — a program only trips one when it does
     work a whole multiple of its analytic floor (the classic regather /
     accidental-replication failure mode), so backend fusion differences
-    never flap the gate. Copy programs (``kv_adopt``/``kv_publish``)
-    move pages between the lane slab and the pool: their bytes ceiling
-    is a slack multiple of the two buffers and their flops are ~0 (a
-    flat allowance covers index arithmetic).
+    never flap the gate. Copy programs (``kv_adopt``/``kv_publish``/
+    ``kv_page_copy``) move pages between KV buffers: their bytes
+    ceiling is a slack multiple of the buffers involved and their flops
+    are ~0 (a flat allowance covers index arithmetic). ``paged=True``
+    marks a pool-native lane program (PR 16): its forward reads K/V
+    through a page-table gather out of the pool and scatters the new
+    rows back, so its ceiling grows by ~two extra pool traversals per
+    step — page indirection that costs MORE than that is exactly the
+    regression this gate exists to catch.
     """
-    if family in ("kv_adopt", "kv_publish"):
+    if family in ("kv_adopt", "kv_publish", "kv_page_copy"):
         return {
             "bytes_accessed": slack * (cache_bytes + pool_bytes),
             "flops": slack * cache_elems + 1e6,
@@ -158,6 +164,9 @@ def program_cost_ceilings(
     # attention reads/writes the KV window per token, and on small
     # models that activation traffic dwarfs the one-time weight read
     base_bytes = param_bytes + (1.0 + tokens) * cache_bytes + pool_bytes
+    if paged:
+        # page-table gather (view materialization) + row scatter-back
+        base_bytes += 2.0 * pool_bytes
     return {
         "bytes_accessed": slack * steps * base_bytes,
         "flops": (

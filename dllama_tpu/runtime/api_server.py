@@ -239,6 +239,11 @@ class _AdmittingLane:
     # detector, counts) instead of building a fresh one, so the client's
     # stream continues byte-identically after the re-prefill
     resume_state: "_LaneState | None" = None
+    # oversubscription (PR 16): this admission resumes a PARKED stream —
+    # same reinstall contract as a crash-recovery resume, but the park
+    # was voluntary (scheduler made room for a queued request), so it
+    # gets its own recorder event + metrics instead of "lane_recovered"
+    from_park: bool = False
 
 
 def _env_int(name: str, default: int) -> int:
@@ -263,20 +268,42 @@ def resolve_lane_knobs(
 
 
 def resolve_kv_knobs(
-    kv_page_size: int | None = None, kv_pool_pages: int | None = None
-) -> tuple[int, int]:
+    kv_page_size: int | None = None,
+    kv_pool_pages: int | None = None,
+    kv_native: bool | None = None,
+) -> tuple[int, int, bool]:
     """Paged-KV knob resolution, same precedence as the lane knobs:
     explicit (CLI flag) beats env (DLLAMA_KV_PAGE_SIZE /
-    DLLAMA_KV_POOL_PAGES) beats default. page_size 0 = the manager's
-    default (16); page_size < 0 DISABLES the paged pool (the lane path
-    then has no prefix reuse at all — the sharing-off baseline the
-    serving bench compares against). pool_pages 0 = auto-size from the
-    engine (2 * seq_len/page_size + 1)."""
+    DLLAMA_KV_POOL_PAGES / DLLAMA_KV_NATIVE) beats default. page_size
+    0 = the manager's default (16); page_size < 0 DISABLES the paged
+    pool (the lane path then has no prefix reuse at all — the
+    sharing-off baseline the serving bench compares against).
+    pool_pages 0 = auto-size from the engine (2 * seq_len/page_size + 1
+    slab mode; one pool per lane + headroom in native mode). kv_native
+    1 = pool-native paged decode: lanes read/write KV through a page
+    table straight into the shared pool, adopt is a refcount bump and
+    publish an ownership transfer (zero device copies on page-aligned
+    prefixes)."""
     if kv_page_size is None:
         kv_page_size = _env_int("DLLAMA_KV_PAGE_SIZE", 0)
     if kv_pool_pages is None:
         kv_pool_pages = _env_int("DLLAMA_KV_POOL_PAGES", 0)
-    return int(kv_page_size), int(kv_pool_pages)
+    if kv_native is None:
+        kv_native = bool(_env_int("DLLAMA_KV_NATIVE", 0))
+    return int(kv_page_size), int(kv_pool_pages), bool(kv_native)
+
+
+def resolve_stream_knobs(max_streams: int | None = None) -> int:
+    """Oversubscription knob, same precedence chain: explicit
+    (--max-streams) beats env (DLLAMA_MAX_STREAMS) beats default 0 =
+    off (streams cap at the lane count, the pre-PR16 behavior). A value
+    above the lane count lets the scheduler admit that many concurrent
+    streams, PARKING active lanes (publish whole pages + drop the page
+    list, radix entry kept) to make room, and resuming parked streams
+    through the recovery-admission path with near-zero re-prefill."""
+    if max_streams is None:
+        max_streams = _env_int("DLLAMA_MAX_STREAMS", 0)
+    return int(max_streams)
 
 
 def resolve_resilience_knobs(
@@ -329,10 +356,21 @@ class LaneScheduler:
         admission_chunk: int | None = None,
         speculation: str = "off",
         spec_k: int = DEFAULT_SPEC_K,
+        max_streams: int = 0,
     ):
         self.state = state
         self.engine = state.engine
         self.block_size = max(1, int(block_size))
+        # oversubscription (PR 16): admit up to max_streams concurrent
+        # streams over batch_size lanes by parking/resuming (0 = off).
+        # Parking needs the shared pool to hold the parked KV, so the
+        # knob is inert when kv sharing is disabled.
+        self.max_streams = max(0, int(max_streams))
+        # tokens generated since the lane was last (re)admitted: a park
+        # victim must have decoded at least one full block since, so a
+        # pathological queue can't thrash park/resume without progress
+        self._progress: list[int] = [0] * state.engine.batch_size
+        self._n_parked = 0
         # model-free speculation (runtime/spec.py): greedy lanes draft
         # from their own context and verify k tokens per dispatch;
         # "off" is a pure bypass (no drafters, no verify programs)
@@ -470,6 +508,10 @@ class LaneScheduler:
         adm = self.admitting.pop(lane, None)
         if adm is None:
             return
+        if adm.from_park:
+            # a parked stream that failed to resume is parked no more
+            self._n_parked -= 1
+            self.state.m_streams_parked.set(self._n_parked)
         if adm.resume_state is not None:
             # a recovery resume that failed again: the original stream's
             # decode span is still open — close it with the error
@@ -521,6 +563,15 @@ class LaneScheduler:
         admission dispatch poisoned the cache — gets a structured
         retryable error."""
         err = {"message": str(e), "retryable": True}
+        native = self.kv is not None and getattr(self.kv, "native", False)
+        if native:
+            # pool-native lanes decode straight out of the pool; the
+            # guard that moved the epoch rebuilt the POOL buffer too, so
+            # every page id (lane retains, radix entries, mid-admission
+            # adopt lists) points into dead memory — reset the host
+            # accounting to match (reset_device=False: the dispatch
+            # guard already rebuilt the buffer)
+            self.kv.reset(reset_device=False)
         n_resumed = 0
         for lane in list(self.admitting):
             adm = self.admitting[lane]
@@ -531,6 +582,13 @@ class LaneScheduler:
             # must re-run too (it targeted the old buffer)
             adm.cursor = adm.start_pos
             adm.adopted = False
+            if native:
+                # the adopted prefix's pages died with the pool: this
+                # admission restarts from position 0 with a fresh page
+                # allocation on its re-run adopt tick
+                adm.cursor = 0
+                adm.start_pos = 0
+                adm.adopt_pages = []
         for lane in range(len(self.lanes)):
             ls = self.lanes[lane]
             if ls is None:
@@ -615,6 +673,11 @@ class LaneScheduler:
             )
             for lane, job in admissions:
                 self._begin_admission(lane, job)
+            # oversubscription (PR 16): requests queued while every lane
+            # is busy and --max-streams allows more concurrency — park
+            # the most-progressed lane (publish + drop page list); it
+            # frees this tick and the queued request admits next tick
+            self._maybe_park(n_pending)
             # stall-free admission: at most ONE bounded prefill chunk per
             # tick, then a decode block for every active lane — the worst
             # case inter-token gap is one chunk + one block, and two
@@ -673,6 +736,81 @@ class LaneScheduler:
                 # window, don't charge it for the quiet period
                 self._last_decode_end = None
 
+    # -- oversubscription: park / resume (PR 16) ---------------------------
+
+    def _maybe_park(self, n_pending: int) -> None:
+        """Park ONE active lane when requests wait, no lane is free, and
+        the stream cap (--max-streams > lanes) says the queue pressure
+        is oversubscription, not overload. The victim is the lane that
+        decoded the most tokens since its last (re)admission, and it
+        must have at least one full block of progress — so a deep queue
+        rotates lanes round-robin instead of thrashing park/resume.
+        ``n_pending`` is the tick's queue-depth snapshot (taken under
+        the cv in _loop)."""
+        if (
+            self.max_streams <= len(self.lanes)
+            or self.kv is None
+            or n_pending <= 0
+            or self.admitting
+        ):
+            return
+        if any(
+            self.lanes[i] is None and i not in self.admitting
+            for i in range(len(self.lanes))
+        ):
+            return
+        victim, best = -1, self.block_size - 1
+        for lane, ls in enumerate(self.lanes):
+            if ls is None or ls.job.cancelled:
+                continue
+            if self._progress[lane] > best:
+                victim, best = lane, self._progress[lane]
+        if victim >= 0:
+            self._park_stream(victim)
+
+    def _park_stream(self, lane: int) -> None:
+        """Evict an active stream from its lane to make room for a
+        queued request: publish the fed history's whole pages into the
+        shared pool (so the resume re-matches nearly everything), drop
+        the lane's page list (radix entry kept), and requeue the job
+        carrying its preserved _LaneState — exactly the
+        recovery-admission contract (_AdmittingLane resume_state=),
+        minus the crash. The decode span stays open: the client's
+        stream pauses but never observably restarts."""
+        ls = self.lanes[lane]
+        st = self.state
+        rid = ls.job.span.request_id
+        with st.spans.span(
+            "park", component="scheduler", request_id=rid, lane=lane,
+            pos=ls.pos,
+        ):
+            # publish failures self-narrow inside the manager (the
+            # culprit pages release, survivors stay); a 0-token store
+            # just means the resume re-prefills more
+            self.kv.publish(lane, ls.history[: ls.pos])
+            self.kv.release_lane(lane)
+        self.lanes[lane] = None
+        self.drafters.pop(lane, None)
+        self._progress[lane] = 0
+        ls.job._park_resume = ls
+        # parked = queue-visible again: a fresh queue span covers the
+        # parked wait so the timeline shows where the stream's time went
+        ls.job.queue_span = st.spans.begin(
+            "queue", component="scheduler", request_id=rid, parked=True
+        )
+        self._n_parked += 1
+        st.m_streams_parked.set(self._n_parked)
+        self._set_lane_gauge()
+        with self.cv:
+            self.pending.append(ls.job)
+            n_pending = len(self.pending)
+            st.m_queue_depth.set(n_pending)
+            self.cv.notify()
+        st.recorder.record(
+            "stream_park", lane=lane, pos=ls.pos,
+            n_pending=n_pending, n_parked=self._n_parked,
+        )
+
     def _begin_admission(self, lane: int, job: LaneJob) -> None:
         """Resolve the prompt and park it as an _AdmittingLane — the front
         half of the old monolithic _admit, with NO engine work: the adopt
@@ -688,6 +826,14 @@ class LaneScheduler:
         covers only positions [start_pos, prompt_end)."""
         state, tok = self.state, self.state.tokenizer
         p = job.params
+        ls0 = getattr(job, "_park_resume", None)
+        if ls0 is not None:
+            # parked-stream resume: no retokenize (the preserved state's
+            # history IS the fed token stream) — radix re-match, chunked
+            # re-prefill of whatever wasn't published, then
+            # _finish_admission reinstalls the state untouched
+            self._resume_parked(lane, job, ls0)
+            return
         try:
             items = [ChatItem(m.role, m.content) for m in p.messages]
             prompt = state.template.generate(items, append_generation_prompt=True)
@@ -755,6 +901,53 @@ class LaneScheduler:
                 # long) must drop the pages match() just retained
                 self.kv.release_lane(lane)
 
+    def _resume_parked(
+        self, lane: int, job: LaneJob, ls: "_LaneState"
+    ) -> None:
+        """Front half of a parked stream's re-admission: the park
+        published the history's whole pages, so the radix match adopts
+        them back (zero device copies in pool-native mode) and only the
+        page-tail + generated suffix re-prefills."""
+        state = self.state
+        job._park_resume = None
+        try:
+            # park requires the shared pool, so self.kv is non-None here
+            start_pos, adopt_pages = self.kv.match(lane, ls.history)
+            if start_pos > 0:
+                state.m_prefix_hits.inc()
+                state.m_reused_tokens.inc(start_pos)
+                self.kv.note_hit(start_pos)
+            state.spans.end(
+                job.queue_span, lane=lane, resumed_from_park=True,
+                reused_prefix_tokens=start_pos,
+            )
+            self.admitting[lane] = _AdmittingLane(
+                job=job,
+                tokens=list(ls.history),
+                pos0=0,
+                cursor=start_pos,
+                prompt_end=len(ls.history) - 1,
+                max_pos=ls.max_pos,
+                public_prompt="",
+                start_pos=start_pos,
+                adopt_pages=adopt_pages,
+                resume_state=ls,
+                from_park=True,
+            )
+        except Exception as e:
+            state.spans.end(job.queue_span, error=str(e))
+            state.spans.end(ls.decode_span, error=str(e))
+            job.events.put(
+                ("error", {"message": str(e), "retryable": True})
+            )
+            if job.span.finish(
+                "error", n_completion=job.n_completion
+            ) is not None:
+                state.m_finished.labels(reason="error").inc()
+            self._n_parked -= 1
+            state.m_streams_parked.set(self._n_parked)
+            self.kv.release_lane(lane)
+
     def _admission_tick(self) -> None:
         """Run at most ONE bounded prefill chunk for ONE admitting lane
         per scheduler tick, round-robin across concurrent admissions, and
@@ -773,8 +966,15 @@ class LaneScheduler:
         wd = self.state.watchdog
         rid = job.span.request_id
         epoch0 = self.engine.cache_epoch
+        # pool-native mode runs the adopt tick even on a zero-token
+        # match: kv.adopt() is where the lane's private pages allocate
+        # and its page table installs — without it there is no KV home
+        # for the prefill to write into
+        adopt_needed = self.kv is not None and (
+            bool(adm.adopt_pages) or getattr(self.kv, "native", False)
+        )
         try:
-            if adm.adopt_pages and not adm.adopted:
+            if adopt_needed and not adm.adopted:
                 # the adopt copy is this lane's first tick action and is
                 # its own tick (one bounded engine dispatch per tick, same
                 # budget discipline as a prefill chunk)
@@ -828,7 +1028,7 @@ class LaneScheduler:
                     done=adm.cursor >= len(fills),
                 )
             if adm.cursor >= len(fills) and (
-                adm.adopted or not adm.adopt_pages
+                adm.adopted or not adopt_needed
             ):
                 self._finish_admission(lane, adm)
         except Exception as e:
@@ -863,21 +1063,37 @@ class LaneScheduler:
         state, tok = self.state, self.state.tokenizer
         job, p = adm.job, adm.job.params
         if adm.resume_state is not None:
-            # crash-recovery resume (see _recover): the re-prefill just
+            # crash-recovery OR park resume: the re-prefill just
             # restored KV rows [0, pos) of the preserved lane state's
             # history — reinstall that state untouched (stream decoder,
             # EOS detector, token counts all intact) and the client's
-            # stream continues exactly where the poisoned dispatch cut
-            # it off. No prompt delta, no fresh spans, no second
-            # "admit": the request never observably restarted.
+            # stream continues exactly where it paused. No prompt delta,
+            # no fresh spans, no second "admit": the request never
+            # observably restarted.
             self.lanes[lane] = adm.resume_state
             del self.admitting[lane]
-            state.m_lanes_recovered.inc()
+            self._progress[lane] = 0
             self._set_lane_gauge()
-            state.recorder.record(
-                "lane_recovered", lane=lane, pos=adm.resume_state.pos,
-                reused_prefix_tokens=adm.start_pos, n_chunks=adm.n_chunks,
-            )
+            if adm.from_park:
+                self._n_parked -= 1
+                state.m_streams_parked.set(self._n_parked)
+                state.m_stream_resumes.inc()
+                state.recorder.record(
+                    "stream_resume", lane=lane, pos=adm.resume_state.pos,
+                    reused_prefix_tokens=adm.start_pos,
+                    n_chunks=adm.n_chunks,
+                )
+                # the park dropped the lane's drafter; greedy lanes get
+                # a fresh one (it re-primes from history on first draft)
+                if self.spec_on and adm.resume_state.temperature <= 0.0:
+                    self.drafters[lane] = NgramDrafter(k_max=self.spec_k)
+            else:
+                state.m_lanes_recovered.inc()
+                state.recorder.record(
+                    "lane_recovered", lane=lane, pos=adm.resume_state.pos,
+                    reused_prefix_tokens=adm.start_pos,
+                    n_chunks=adm.n_chunks,
+                )
             return
         job.span.set_prefill_seconds(adm.prefill_s)
         job.span.set_tokens(n_prompt=len(adm.tokens))
@@ -909,6 +1125,7 @@ class LaneScheduler:
             ),
         )
         del self.admitting[lane]
+        self._progress[lane] = 0
         if self.spec_on and p.temperature <= 0.0:
             # greedy lanes only: a sampled lane's next token is not the
             # argmax the verify pass returns, so it stays on the decode
@@ -924,6 +1141,9 @@ class LaneScheduler:
         """Client went away mid-admission: stop prefilling for nobody."""
         adm = self.admitting.pop(lane)
         job = adm.job
+        if adm.from_park:
+            self._n_parked -= 1
+            self.state.m_streams_parked.set(self._n_parked)
         if adm.resume_state is not None:
             # recovery resume cancelled mid-re-prefill: the original
             # stream's decode span is still open — close it here
@@ -998,6 +1218,7 @@ class LaneScheduler:
         ls = self.lanes[lane]
         if ls is None:
             return False
+        self._progress[lane] += 1
         ls.pos += 1
         ls.token = t
         ls.history.append(t)
@@ -1218,6 +1439,8 @@ class ApiState:
         admission_chunk: int | None = None,
         kv_page_size: int = 0,
         kv_pool_pages: int = 0,
+        kv_native: bool = False,
+        max_streams: int = 0,
         slo_ttft_ms: float | None = None,
         slo_tpot_ms: float | None = None,
         series_retention: float | None = None,
@@ -1419,6 +1642,21 @@ class ApiState:
             "Cumulative accepted/drafted token ratio of the n-gram "
             "speculator (0 until the first verify dispatch).",
         )
+        # oversubscription (PR 16): streams beyond the lane count park
+        # (publish + drop page list, radix entry kept) and resume via
+        # the recovery-admission path
+        self.m_streams_parked = self.obs.gauge(
+            "dllama_streams_parked",
+            "Admitted streams currently parked out of their lane "
+            "(--max-streams oversubscription): KV published to the "
+            "shared pool, page list dropped, waiting to resume.",
+        )
+        self.m_stream_resumes = self.obs.counter(
+            "dllama_stream_resumes_total",
+            "Parked streams resumed into a lane via radix re-match "
+            "through the recovery-admission path (near-zero re-prefill "
+            "when the parked history published page-aligned).",
+        )
         # request defaults captured once: per-request sampler mutations must
         # not leak into later requests' defaults
         self.default_temperature = engine.temperature
@@ -1450,6 +1688,7 @@ class ApiState:
                 page_size=kv_page_size,
                 n_pages=kv_pool_pages,
                 evict_counter=self.m_evictions,
+                native=kv_native,
             )
         # engine watchdog audits the scheduler loop; it must exist BEFORE
         # the scheduler thread starts (the loop beats it every tick). The
@@ -1472,6 +1711,7 @@ class ApiState:
                 admission_chunk=admission_chunk,
                 speculation=speculation,
                 spec_k=spec_k,
+                max_streams=max_streams,
             )
             if lanes_on
             else None
@@ -2379,6 +2619,8 @@ def serve(
     admission_chunk: int | None = None,
     kv_page_size: int | None = None,
     kv_pool_pages: int | None = None,
+    kv_native: bool | None = None,
+    max_streams: int | None = None,
     timeline_out: str | None = None,
     slo_ttft_ms: float | None = None,
     slo_tpot_ms: float | None = None,
@@ -2391,7 +2633,10 @@ def serve(
     faults: str | None = None,
 ):
     block, chunk = resolve_lane_knobs(lane_block_size, admission_chunk)
-    page_size, pool_pages = resolve_kv_knobs(kv_page_size, kv_pool_pages)
+    page_size, pool_pages, native = resolve_kv_knobs(
+        kv_page_size, kv_pool_pages, kv_native
+    )
+    streams = resolve_stream_knobs(max_streams)
     spec_mode, spec_k_val = resolve_spec_knobs(speculation, spec_k)
     r_max, r_backoff, q_depth = resolve_resilience_knobs(
         retry_max, retry_backoff_ms, max_queue_depth
@@ -2410,6 +2655,8 @@ def serve(
         admission_chunk=chunk,
         kv_page_size=page_size,
         kv_pool_pages=pool_pages,
+        kv_native=native,
+        max_streams=streams,
         slo_ttft_ms=slo_ttft_ms,
         slo_tpot_ms=slo_tpot_ms,
         series_retention=series_retention,
@@ -2521,6 +2768,8 @@ def main(argv=None) -> None:
                 admission_chunk=args.admission_chunk,
                 kv_page_size=args.kv_page_size,
                 kv_pool_pages=args.kv_pool_pages,
+                kv_native=args.kv_native,
+                max_streams=args.max_streams,
                 timeline_out=args.timeline_out,
                 slo_ttft_ms=args.slo_ttft_ms,
                 slo_tpot_ms=args.slo_tpot_ms,

@@ -231,6 +231,13 @@ class InferenceEngine:
             "time / tokens in the block).",
             buckets=DEFAULT_TOKEN_BUCKETS_S,
         )
+        self._m_kv_copy_bytes = self.obs.counter(
+            "dllama_kv_copy_bytes_total",
+            "Device bytes moved by KV copy programs: slab adopt/publish "
+            "page copies, plus the pool-native path's COW mid-page tail "
+            "forks (its only remaining device copy — a full-page prefix "
+            "adoption moves zero bytes).",
+        )
         self._obs_last_window = None
 
         self.reader = ModelReader(model_path, max_seq_len=max_seq_len)
@@ -399,6 +406,16 @@ class InferenceEngine:
         self._kv_page_size = 0
         self._kv_pool_pages = 0
         self._kv_pool_specs = None
+        # pool-native mode (ISSUE 16): the pool IS the lane KV home —
+        # decode/verify/prefill read and write through a per-lane page
+        # table instead of the slab, kv_adopt becomes a page-table write
+        # and kv_publish an ownership transfer. kv_pool_epoch moves every
+        # time the pool buffer is reallocated so the manager/scheduler can
+        # tell "this dispatch poisoned the pool" from a transient failure.
+        self.kv_native = False
+        self.kv_pool_epoch = 0
+        self._kv_n_blocks = 0
+        self._page_table = None  # host np.int32 mirror [batch, n_blocks]
         self._compiled = {}
         self._base_key = jax.random.PRNGKey(seed)
         self._lane_seed_base = seed
@@ -1092,22 +1109,39 @@ class InferenceEngine:
         self._require_lanes()
         if not self._aot_blocks:
             return
+        native = self.kv_native
         for bucket in self.prefill_buckets:
             window = self._attn_window(bucket)
-            self._prefetch(
-                ("lane_prefill", bucket, window),
-                lambda b=bucket, w=window: self._lane_prefill_fn(
-                    b, window=w, origin="prefetch"
-                ),
-            )
+            if native:
+                self._prefetch(
+                    ("lane_prefill_paged", bucket, window),
+                    lambda b=bucket, w=window: self._lane_prefill_paged_fn(
+                        b, window=w, origin="prefetch"
+                    ),
+                )
+            else:
+                self._prefetch(
+                    ("lane_prefill", bucket, window),
+                    lambda b=bucket, w=window: self._lane_prefill_fn(
+                        b, window=w, origin="prefetch"
+                    ),
+                )
         if block_size:
             window = self._attn_window(block_size)
-            self._prefetch(
-                ("lane_block", block_size, window),
-                lambda n=block_size, w=window: self._lane_decode_fn(
-                    n, w, origin="prefetch"
-                ),
-            )
+            if native:
+                self._prefetch(
+                    ("lane_block_paged", block_size, window),
+                    lambda n=block_size, w=window: self._lane_decode_paged_fn(
+                        n, w, origin="prefetch"
+                    ),
+                )
+            else:
+                self._prefetch(
+                    ("lane_block", block_size, window),
+                    lambda n=block_size, w=window: self._lane_decode_fn(
+                        n, w, origin="prefetch"
+                    ),
+                )
         if spec_k > 0:
             # one verify program per draft bucket (width 1 + bucket for
             # the pending token) at the base window; deeper windows ride
@@ -1117,13 +1151,28 @@ class InferenceEngine:
             for kb in spec_buckets(min(spec_k, self._lane_pad - 1)):
                 t = kb + 1
                 window = self._attn_window(t)
-                self._prefetch(
-                    ("lane_verify", t, window),
-                    lambda tt=t, w=window: self._lane_verify_fn(
-                        tt, w, origin="prefetch"
-                    ),
-                )
-        if self.kv_pool is not None:
+                if native:
+                    self._prefetch(
+                        ("lane_verify_paged", t, window),
+                        lambda tt=t, w=window: self._lane_verify_paged_fn(
+                            tt, w, origin="prefetch"
+                        ),
+                    )
+                else:
+                    self._prefetch(
+                        ("lane_verify", t, window),
+                        lambda tt=t, w=window: self._lane_verify_fn(
+                            tt, w, origin="prefetch"
+                        ),
+                    )
+        if self.kv_pool is not None and native:
+            # the only device copy left on the native path: the COW fork
+            # of a mid-page adoption boundary (one page at a time)
+            self._prefetch(
+                ("kv_page_copy", 1),
+                lambda: self._kv_page_copy_fn(1, origin="prefetch"),
+            )
+        elif self.kv_pool is not None:
             # page-copy programs sit on the admission (adopt) and finish
             # (publish) paths; pre-build every power-of-two bucket up to a
             # full sequence's page count
@@ -1187,10 +1236,17 @@ class InferenceEngine:
         chunk = tokens[:width] + [0] * (bucket - width)
         rows = [[0] * bucket for _ in range(self.batch_size)]
         rows[lane] = chunk
-        posv = [self._park] * self.batch_size
-        posv[lane] = pos0
         window = self._attn_window(pos0 + bucket)
-        step = self._lane_prefill_fn(bucket, window=window)
+        native = self.kv_native
+        # the paged view parks at `window` (its tail rows); the slab
+        # parks at seq_len (its padding rows)
+        posv = [window if native else self._park] * self.batch_size
+        posv[lane] = pos0
+        step = (
+            self._lane_prefill_paged_fn(bucket, window=window)
+            if native
+            else self._lane_prefill_fn(bucket, window=window)
+        )
         self.recorder.record(
             "step_dispatch", step="prefill_lane_chunk", lane=lane, pos=pos0,
             n_tokens=width, bucket=bucket, window=window,
@@ -1204,10 +1260,19 @@ class InferenceEngine:
             jnp.asarray(rows, jnp.int32), self._token_sharding
         )
         pos_arr = jnp.asarray(posv, jnp.int32)
-        with self._cache_guard():
-            if fault is not None:
-                raise fault
-            self.cache = step(self.params, arr, self.cache, pos_arr)
+        if native:
+            with self._kv_pool_guard():
+                if fault is not None:
+                    raise fault
+                self.kv_pool = step(
+                    self.params, arr, self.kv_pool,
+                    jnp.asarray(self._page_table), pos_arr,
+                )
+        else:
+            with self._cache_guard():
+                if fault is not None:
+                    raise fault
+                self.cache = step(self.params, arr, self.cache, pos_arr)
         dt = time.perf_counter() - t0
         self._spans.end(sp)
         self._m_step.labels(kind="prefill_lane_chunk").observe(dt)
@@ -1294,12 +1359,18 @@ class InferenceEngine:
             for k in ("k", "v")
         }
 
-    def init_kv_pool(self, page_size: int, n_pages: int = 0) -> int:
+    def init_kv_pool(
+        self, page_size: int, n_pages: int = 0, native: bool = False
+    ) -> int:
         """Allocate the shared KV page pool: ``[L, n_pages, KH, page_size,
         hd]`` per k/v leaf (QuantKV pairs under int8 KV), replicated over
         the page axis and sharded like the cache elsewhere. Page 0 is the
         scratch page bucketed copy programs pad with. ``n_pages`` <= 0
-        picks a budget of two full-length sequences' worth of pages.
+        picks a budget of two full-length sequences' worth of pages (in
+        native mode: one sequence per lane plus two shareable sequences,
+        since the pool is then the only KV home). ``native=True`` switches
+        decode/verify/prefill to the pool-native paged programs; each lane
+        reads K/V through its page-table row instead of its slab rows.
         Returns the page count actually allocated."""
         self._require_lanes()
         if page_size < 1:
@@ -1311,12 +1382,25 @@ class InferenceEngine:
             raise ValueError(
                 f"page_size {page_size} exceeds lane padding {self._lane_pad}"
             )
+        if native and (self.pp > 1 or self.sp > 1):
+            # the pp fwd closure parks at the slab's seq_len and sp shards
+            # the sequence axis; both assume slab geometry — the native
+            # paged view parks at `window` and is unsharded on its row axis
+            raise ValueError("kv_native requires pp == 1 and sp == 1")
+        n_blocks = -(-self.header.seq_len // page_size)
         if n_pages <= 0:
-            n_pages = 2 * (self.header.seq_len // page_size) + 1
+            if native:
+                n_pages = (self.batch_size + 2) * n_blocks + 1
+            else:
+                n_pages = 2 * (self.header.seq_len // page_size) + 1
         self._kv_page_size = page_size
         self._kv_pool_pages = n_pages
+        self._kv_n_blocks = n_blocks
+        self.kv_native = bool(native)
+        self._page_table = np.zeros((self.batch_size, n_blocks), np.int32)
         self.kv_pool = self._alloc_kv_pool()
         self._kv_pool_specs = jax.tree.map(_sds, self.kv_pool)
+        self.kv_pool_epoch += 1
         return n_pages
 
     def reset_kv_pool(self) -> None:
@@ -1325,13 +1409,65 @@ class InferenceEngine:
         match."""
         self._require_kv_pool()
         self.kv_pool = self._alloc_kv_pool()
+        self.kv_pool_epoch += 1
+        if self._page_table is not None:
+            self._page_table[:] = 0
+
+    def adopt_pages(self, lane: int, page_ids: list[int]) -> None:
+        """Pool-native kv_adopt: point ``lane``'s page-table row at
+        ``page_ids`` (slot i backs rows [i*ps, (i+1)*ps)). No device work
+        — this is the whole point. Unfilled slots fall back to the scratch
+        page 0, which only ever receives parked/out-of-range writes."""
+        self._require_kv_pool()
+        if not self.kv_native:
+            raise ValueError("adopt_pages requires kv_native mode")
+        if not 0 <= lane < self.batch_size:
+            raise ValueError(f"lane {lane} out of range")
+        if len(page_ids) > self._kv_n_blocks:
+            raise ValueError(
+                f"{len(page_ids)} pages exceed {self._kv_n_blocks} blocks"
+            )
+        row = self._page_table[lane]
+        row[:] = 0
+        if page_ids:
+            row[: len(page_ids)] = np.asarray(page_ids, np.int32)
+        self.recorder.record(
+            "step_complete", step="kv_adopt", lane=lane,
+            n_pages=len(page_ids), ms=0.0, native=True,
+        )
+
+    def clear_lane_pages(self, lane: int) -> None:
+        """Drop ``lane``'s page-table row (back to the scratch page)."""
+        if self._page_table is not None:
+            self._page_table[lane] = 0
+
+    def clear_all_lane_pages(self) -> None:
+        if self._page_table is not None:
+            self._page_table[:] = 0
+
+    def _kv_page_bytes(self) -> int:
+        """Device bytes per pool page, summed over k/v (and QuantKV
+        scale) leaves and all layers — the unit dllama_kv_copy_bytes_total
+        counts in."""
+        total = 0
+        for leaf in jax.tree.leaves(self._kv_pool_specs):
+            n = 1
+            for i, d in enumerate(leaf.shape):
+                if i != 1:  # every axis but the page axis
+                    n *= d
+            total += n * jnp.dtype(leaf.dtype).itemsize
+        return total
 
     @contextlib.contextmanager
     def _kv_pool_guard(self):
         """Crash consistency for the donated pool buffer (the publish
         program's analogue of _cache_guard): a failed dispatch may leave
         the pool half-donated, so rebuild it before re-raising. Host-side
-        accounting is the manager's to reset."""
+        accounting is the manager's to reset. kv_pool_epoch moves so the
+        manager can tell pool-poisoning failures from transient ones; in
+        native mode cache_epoch moves too — the pool IS the lane KV, so
+        the scheduler's existing poisoned/transient classification keeps
+        working unchanged."""
         try:
             yield
         except BaseException as e:
@@ -1342,6 +1478,13 @@ class InferenceEngine:
                 self.kv_pool = self._alloc_kv_pool()
             except Exception as rebuild_err:  # pragma: no cover
                 raise rebuild_err from e
+            self.kv_pool_epoch += 1
+            if self.kv_native:
+                self.cache_epoch += 1
+                self._m_epochs.inc()
+                self.recorder.record(
+                    "cache_epoch", epoch=self.cache_epoch, native=True
+                )
             raise
 
     def _kv_copy_arg_specs(self, bucket: int):
@@ -1480,6 +1623,7 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         self._spans.end(sp)
         self._m_step.labels(kind="kv_adopt").observe(dt)
+        self._m_kv_copy_bytes.inc(n * self._kv_page_bytes())
         self.recorder.record(
             "step_complete", step="kv_adopt", lane=lane, n_pages=n,
             ms=round(dt * 1000, 3),
@@ -1529,10 +1673,370 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         self._spans.end(sp)
         self._m_step.labels(kind="kv_publish").observe(dt)
+        self._m_kv_copy_bytes.inc(n * self._kv_page_bytes())
         self.recorder.record(
             "step_complete", step="kv_publish", lane=lane, n_pages=n,
             ms=round(dt * 1000, 3),
         )
+
+    # -- pool-native paged programs (ISSUE 16) -------------------------------
+    #
+    # In kv_native mode the pool is the only KV home: each compiled lane
+    # program GATHERS the window's pages through the per-lane page table
+    # into a contiguous [L, B, KH, window + T, hd] view, runs the exact
+    # slab loop body on that view (so live lanes see bit-identical K/V
+    # rows and produce bit-identical logits), and SCATTERS the rows it
+    # wrote back to the lanes' private pages. Rows at-or-beyond `window`
+    # are the view's parking tail (the slab parks at seq_len; the view
+    # parks at `window`) and are never scattered — parked/out-of-range
+    # garbage stays in the discarded view copy.
+
+    def _kv_page_copy_arg_specs(self, bucket: int):
+        return (
+            self._kv_pool_specs,
+            jax.ShapeDtypeStruct((bucket,), jnp.int32),
+            jax.ShapeDtypeStruct((bucket,), jnp.int32),
+        )
+
+    def _kv_page_copy_fn(self, bucket: int = 1, origin: str = "dispatch"):
+        """Pool-internal page copy (src pages -> dst pages), donating the
+        pool: the COW fork of a mid-page adoption tail — the ONLY device
+        copy left on the native admission path."""
+        key = ("kv_page_copy", bucket)
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
+            ev = self._inflight.get(key) if origin == "dispatch" else None
+        if ev is not None:
+            ev.wait()
+            with self._compile_lock:
+                if key in self._compiled:
+                    return self._compiled[key]
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def fn(pool, src, dst):
+            def leaf(p):
+                return p.at[:, dst].set(p[:, src])
+
+            return jax.tree.map(leaf, pool)
+
+        self.recorder.record("compile_start", key=str(key), origin=origin)
+        t0 = time.perf_counter()
+        if self._aot_blocks:
+            fn = fn.lower(*self._kv_page_copy_arg_specs(bucket)).compile()
+        dt = time.perf_counter() - t0
+        with self._compile_lock:
+            self._compiled[key] = fn
+            self._compile_origin[key] = origin
+            if self._aot_blocks:
+                self._compile_seconds[key] = dt
+        self._m_compiles.labels(origin=origin).inc()
+        self.recorder.record(
+            "compile_end", key=str(key), origin=origin, s=round(dt, 4)
+        )
+        self._xlalint_after_compile(key)
+        return fn
+
+    def kv_page_copy(self, src_ids: list[int], dst_ids: list[int]) -> None:
+        """Copy pool pages ``src_ids[i]`` -> ``dst_ids[i]`` on device."""
+        self._require_kv_pool()
+        n = len(src_ids)
+        if n < 1 or len(dst_ids) != n:
+            raise ValueError("src/dst page lists must match and be non-empty")
+        fault = self._fault("kv_page_copy")
+        if fault is not None and not fault.poison:
+            raise fault
+        self.recorder.record(
+            "step_dispatch", step="kv_page_copy", n_pages=n
+        )
+        sp = self._spans.begin(
+            "kv_page_copy", component="engine", n_pages=n
+        )
+        t0 = time.perf_counter()
+        for start, bucket in self._kv_copy_chunks(n):
+            fn = self._kv_page_copy_fn(bucket)
+            src = jnp.asarray(src_ids[start : start + bucket], jnp.int32)
+            dst = jnp.asarray(dst_ids[start : start + bucket], jnp.int32)
+            with self._kv_pool_guard():
+                if fault is not None:
+                    raise fault
+                self.kv_pool = fn(self.kv_pool, src, dst)
+        dt = time.perf_counter() - t0
+        self._spans.end(sp)
+        self._m_step.labels(kind="kv_page_copy").observe(dt)
+        self._m_kv_copy_bytes.inc(n * self._kv_page_bytes())
+        self.recorder.record(
+            "step_complete", step="kv_page_copy", n_pages=n,
+            ms=round(dt * 1000, 3),
+        )
+
+    def _paged_gather(self, pool, pt, window: int, tail: int):
+        """Contiguous per-lane KV view of the first `window` rows plus a
+        `tail`-row parking pad, gathered through the page table."""
+        ps = self._kv_page_size
+        wb = -(-window // ps)
+
+        def leaf(p):
+            pages = p[:, pt[:, :wb]]  # [L, B, wb, KH, ps, last]
+            l_, b, _, kh, _, last = pages.shape
+            rows = pages.transpose(0, 1, 3, 2, 4, 5).reshape(
+                l_, b, kh, wb * ps, last
+            )
+            rows = rows[:, :, :, :window, :]
+            pad = jnp.zeros((l_, b, kh, tail, last), p.dtype)
+            return jnp.concatenate([rows, pad], axis=3)
+
+        return jax.tree.map(leaf, pool)
+
+    def _paged_scatter(self, pool, view, pt, rows, safe):
+        """Write view rows back to the pool: view row `rows[b, t]` of lane
+        b lands in that lane's page-table page for slot rows//ps at page
+        row rows%ps. Unsafe entries (parked lanes, rows at-or-beyond the
+        window) collapse onto the scratch page 0 — a don't-care row no
+        live read ever resolves to. Safe rows always map to lane-PRIVATE
+        pages (the manager COW-forks a shared mid-page boundary before
+        admission), so cross-lane scatter collisions cannot happen."""
+        ps = self._kv_page_size
+        nb = pt.shape[1]
+        slot = jnp.clip(rows // ps, 0, nb - 1)
+        page = jnp.where(safe, jnp.take_along_axis(pt, slot, axis=1), 0)
+        prow = jnp.where(safe, rows % ps, 0)
+        srow = jnp.where(safe, rows, 0)
+
+        def leaf(p, v):
+            vals = jnp.take_along_axis(
+                v, srow[None, :, None, :, None], axis=3
+            )  # [L, B, KH, T, last]
+            return p.at[:, page, :, prow, :].set(
+                vals.transpose(1, 3, 0, 2, 4)
+            )
+
+        return jax.tree.map(leaf, pool, view)
+
+    def _lane_paged_specs(self, t: int):
+        b = self.batch_size
+        tok = jax.ShapeDtypeStruct(
+            (b, t), jnp.int32, sharding=self._token_sharding
+        )
+        return (
+            self._param_specs,
+            tok,
+            self._kv_pool_specs,
+            jax.ShapeDtypeStruct((b, self._kv_n_blocks), jnp.int32),
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+        )
+
+    def _lane_decode_paged_arg_specs(self, n_steps: int):
+        b = self.batch_size
+        return self._lane_paged_specs(1) + (
+            jax.ShapeDtypeStruct((b,), jnp.bool_),
+            jax.ShapeDtypeStruct((b,), jnp.int32),  # per-lane seeds
+            jax.ShapeDtypeStruct((b,), jnp.float32),
+            jax.ShapeDtypeStruct((b,), jnp.float32),
+        )
+
+    def _lane_decode_paged_fn(
+        self, n_steps: int, window: int, origin: str = "dispatch"
+    ):
+        """Pool-native decode block: _lane_decode_fn's loop body run on
+        the gathered page view (donating the POOL, not the slab). Live
+        lanes read/write the exact rows the slab program would, so the
+        emitted tokens are bit-identical; the slab cache is untouched."""
+        key = ("lane_block_paged", n_steps, window)
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
+            ev = self._inflight.get(key) if origin == "dispatch" else None
+        if ev is not None:
+            ev.wait()
+            with self._compile_lock:
+                if key in self._compiled:
+                    return self._compiled[key]
+        precision = self._precision
+        fwd = self._fwd
+        seq_len = self.header.seq_len
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def block(
+            params, token, pool, pt, pos_vec, active, seeds,
+            temperature, topp,
+        ):
+            view = self._paged_gather(pool, pt, window, n_steps)
+
+            def body(i, carry):
+                tok, view, out = carry
+                ok = jnp.logical_and(active, pos_vec + i < seq_len)
+                cur = jnp.where(ok, pos_vec + i, window)
+                ctx = (
+                    jax.default_matmul_precision(precision)
+                    if precision
+                    else contextlib.nullcontext()
+                )
+                with ctx:
+                    logits, view = fwd(
+                        params, tok, cur, view,
+                        attn_window=window,
+                        attn_park_threshold=window, logits_mode="last",
+                    )
+                last = logits[:, -1, :]
+                nxt = _sample_per_lane(last, temperature, topp, seeds, cur)
+                nxt = jnp.where(ok, nxt, 0).reshape(-1, 1)
+                out = lax.dynamic_update_index_in_dim(
+                    out, nxt[:, 0], i, axis=0
+                )
+                return nxt, view, out
+
+            out0 = jnp.zeros((n_steps, token.shape[0]), jnp.int32)
+            _, view, out = lax.fori_loop(
+                0, n_steps, body, (token, view, out0)
+            )
+            rows = pos_vec[:, None] + jnp.arange(n_steps)[None, :]
+            safe = jnp.logical_and(active[:, None], rows < window)
+            pool = self._paged_scatter(pool, view, pt, rows, safe)
+            return out, pool
+
+        self.recorder.record("compile_start", key=str(key), origin=origin)
+        t0 = time.perf_counter()
+        if self._aot_blocks:
+            block = block.lower(
+                *self._lane_decode_paged_arg_specs(n_steps)
+            ).compile()
+        dt = time.perf_counter() - t0
+        with self._compile_lock:
+            self._compiled[key] = block
+            self._compile_origin[key] = origin
+            if self._aot_blocks:
+                self._compile_seconds[key] = dt
+        self._m_compiles.labels(origin=origin).inc()
+        self.recorder.record(
+            "compile_end", key=str(key), origin=origin, s=round(dt, 4)
+        )
+        self._xlalint_after_compile(key)
+        return block
+
+    def _lane_verify_paged_arg_specs(self, t: int):
+        b = self.batch_size
+        return self._lane_paged_specs(t) + (
+            jax.ShapeDtypeStruct((b,), jnp.bool_),
+        )
+
+    def _lane_verify_paged_fn(
+        self, t: int, window: int, origin: str = "dispatch"
+    ):
+        """Pool-native speculative verify: _lane_verify_fn on the page
+        view (one fwd over t tokens, greedy argmax grid back)."""
+        key = ("lane_verify_paged", t, window)
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
+            ev = self._inflight.get(key) if origin == "dispatch" else None
+        if ev is not None:
+            ev.wait()
+            with self._compile_lock:
+                if key in self._compiled:
+                    return self._compiled[key]
+        precision = self._precision
+        fwd = self._fwd
+        seq_len = self.header.seq_len
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def vstep(params, tokens, pool, pt, pos_vec, active):
+            view = self._paged_gather(pool, pt, window, t)
+            cur = jnp.where(active, pos_vec, window)
+            ctx = (
+                jax.default_matmul_precision(precision)
+                if precision
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                logits, view = fwd(
+                    params, tokens, cur, view,
+                    attn_window=window, attn_park_threshold=window,
+                    logits_mode="all", n_micro=self._pp_micro(t),
+                )
+            out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out = jnp.where(active[:, None], out, 0)
+            rows = cur[:, None] + jnp.arange(t)[None, :]
+            safe = jnp.logical_and(
+                jnp.logical_and(active[:, None], rows < window),
+                rows < seq_len,
+            )
+            pool = self._paged_scatter(pool, view, pt, rows, safe)
+            return out, pool
+
+        self.recorder.record("compile_start", key=str(key), origin=origin)
+        t0 = time.perf_counter()
+        if self._aot_blocks:
+            vstep = vstep.lower(
+                *self._lane_verify_paged_arg_specs(t)
+            ).compile()
+        dt = time.perf_counter() - t0
+        with self._compile_lock:
+            self._compiled[key] = vstep
+            self._compile_origin[key] = origin
+            if self._aot_blocks:
+                self._compile_seconds[key] = dt
+        self._m_compiles.labels(origin=origin).inc()
+        self.recorder.record(
+            "compile_end", key=str(key), origin=origin, s=round(dt, 4)
+        )
+        self._xlalint_after_compile(key)
+        return vstep
+
+    def _lane_prefill_paged_fn(
+        self, t: int, window: int, origin: str = "dispatch"
+    ):
+        """Pool-native lane-prefill chunk: _lane_prefill_fn on the page
+        view. Parked lanes are fed pos = `window` (the view's parking
+        tail), so their writes never scatter back."""
+        key = ("lane_prefill_paged", t, window)
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
+            ev = self._inflight.get(key) if origin == "dispatch" else None
+        if ev is not None:
+            ev.wait()
+            with self._compile_lock:
+                if key in self._compiled:
+                    return self._compiled[key]
+        precision = self._precision
+        fwd = self._fwd
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def step(params, tokens, pool, pt, pos_vec):
+            view = self._paged_gather(pool, pt, window, t)
+            ctx = (
+                jax.default_matmul_precision(precision)
+                if precision
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                _, view = fwd(
+                    params, tokens, pos_vec, view,
+                    attn_window=window, attn_park_threshold=window,
+                    logits_mode="last", n_micro=self._pp_micro(t),
+                )
+            rows = pos_vec[:, None] + jnp.arange(t)[None, :]
+            safe = rows < window
+            pool = self._paged_scatter(pool, view, pt, rows, safe)
+            return pool
+
+        self.recorder.record("compile_start", key=str(key), origin=origin)
+        t0 = time.perf_counter()
+        if self._aot_blocks:
+            step = step.lower(*self._lane_paged_specs(t)).compile()
+        dt = time.perf_counter() - t0
+        with self._compile_lock:
+            self._compiled[key] = step
+            self._compile_origin[key] = origin
+            if self._aot_blocks:
+                self._compile_seconds[key] = dt
+        self._m_compiles.labels(origin=origin).inc()
+        self.recorder.record(
+            "compile_end", key=str(key), origin=origin, s=round(dt, 4)
+        )
+        self._xlalint_after_compile(key)
+        return step
 
     def _lane_arg_specs(self, n_steps: int):
         """Arg specs for a decode_lanes dispatch (the AOT pre-compile's
@@ -1687,18 +2191,33 @@ class InferenceEngine:
         deepest = max(pos[i] for i in live)
         window = self._attn_window(deepest + n_steps)
         self._note_window(window)
-        block = self._lane_decode_fn(n_steps, window)
+        native = self.kv_native
+        block = (
+            self._lane_decode_paged_fn(n_steps, window)
+            if native
+            else self._lane_decode_fn(n_steps, window)
+        )
         if (
             self._aot_blocks
             and window < self.header.seq_len
             and deepest + n_steps >= (3 * window) // 4
         ):
-            self._prefetch(
-                ("lane_block", n_steps, self._attn_window(window + 1)),
-                lambda nw=self._attn_window(window + 1): self._lane_decode_fn(
-                    n_steps, nw, origin="prefetch"
-                ),
-            )
+            if native:
+                self._prefetch(
+                    ("lane_block_paged", n_steps, self._attn_window(window + 1)),
+                    lambda nw=self._attn_window(window + 1):
+                        self._lane_decode_paged_fn(
+                            n_steps, nw, origin="prefetch"
+                        ),
+                )
+            else:
+                self._prefetch(
+                    ("lane_block", n_steps, self._attn_window(window + 1)),
+                    lambda nw=self._attn_window(window + 1):
+                        self._lane_decode_fn(
+                            n_steps, nw, origin="prefetch"
+                        ),
+                )
         self._rng_calls += 1
         # unseeded lanes draw from an engine-lifetime stream (varies per
         # call); a seeded lane's stream depends ONLY on (its seed, its
@@ -1722,19 +2241,33 @@ class InferenceEngine:
             pos=deepest, n_live=len(live), window=window,
         )
         t0 = time.perf_counter()
-        with self._cache_guard():
+        guard = self._kv_pool_guard if native else self._cache_guard
+        with guard():
             if fault is not None:
                 raise fault
-            out, self.cache = block(
-                self.params,
-                arr,
-                self.cache,
-                pos_arr,
-                act_arr,
-                jnp.asarray(seed_vec, jnp.int32),
-                jnp.asarray(temperature, jnp.float32),
-                jnp.asarray(topp, jnp.float32),
-            )
+            if native:
+                out, self.kv_pool = block(
+                    self.params,
+                    arr,
+                    self.kv_pool,
+                    jnp.asarray(self._page_table),
+                    pos_arr,
+                    act_arr,
+                    jnp.asarray(seed_vec, jnp.int32),
+                    jnp.asarray(temperature, jnp.float32),
+                    jnp.asarray(topp, jnp.float32),
+                )
+            else:
+                out, self.cache = block(
+                    self.params,
+                    arr,
+                    self.cache,
+                    pos_arr,
+                    act_arr,
+                    jnp.asarray(seed_vec, jnp.int32),
+                    jnp.asarray(temperature, jnp.float32),
+                    jnp.asarray(topp, jnp.float32),
+                )
             # the call above returned as soon as the program was enqueued;
             # the readback is the device-complete wait — split it out so a
             # timeline shows dispatch overhead vs device time
@@ -1883,18 +2416,33 @@ class InferenceEngine:
         deepest = max(pos[i] for i in live)
         window = self._attn_window(deepest + t)
         self._note_window(window)
-        vstep = self._lane_verify_fn(t, window)
+        native = self.kv_native
+        vstep = (
+            self._lane_verify_paged_fn(t, window)
+            if native
+            else self._lane_verify_fn(t, window)
+        )
         if (
             self._aot_blocks
             and window < self.header.seq_len
             and deepest + t >= (3 * window) // 4
         ):
-            self._prefetch(
-                ("lane_verify", t, self._attn_window(window + 1)),
-                lambda nw=self._attn_window(window + 1): self._lane_verify_fn(
-                    t, nw, origin="prefetch"
-                ),
-            )
+            if native:
+                self._prefetch(
+                    ("lane_verify_paged", t, self._attn_window(window + 1)),
+                    lambda nw=self._attn_window(window + 1):
+                        self._lane_verify_paged_fn(
+                            t, nw, origin="prefetch"
+                        ),
+                )
+            else:
+                self._prefetch(
+                    ("lane_verify", t, self._attn_window(window + 1)),
+                    lambda nw=self._attn_window(window + 1):
+                        self._lane_verify_fn(
+                            t, nw, origin="prefetch"
+                        ),
+                )
         arr = jax.device_put(
             jnp.asarray(rows, jnp.int32), self._token_sharding
         )
@@ -1912,12 +2460,19 @@ class InferenceEngine:
             pos=deepest, n_live=len(live), window=window,
         )
         t0 = time.perf_counter()
-        with self._cache_guard():
+        guard = self._kv_pool_guard if native else self._cache_guard
+        with guard():
             if fault is not None:
                 raise fault
-            out, self.cache = vstep(
-                self.params, arr, self.cache, pos_arr, act_arr
-            )
+            if native:
+                out, self.kv_pool = vstep(
+                    self.params, arr, self.kv_pool,
+                    jnp.asarray(self._page_table), pos_arr, act_arr,
+                )
+            else:
+                out, self.cache = vstep(
+                    self.params, arr, self.cache, pos_arr, act_arr
+                )
             sp_dev = self._spans.begin(
                 "verify_lanes.device", component="engine"
             )
@@ -2206,6 +2761,13 @@ class InferenceEngine:
                 "lane_block": "decode_lanes",
                 "lane_prefill": "prefill_lane",
                 "lane_verify": "verify_lanes",
+                # pool-native paged variants observe into the same step
+                # kinds as their slab twins — serving dashboards don't
+                # care which KV home a block decoded from
+                "lane_block_paged": "decode_lanes",
+                "lane_prefill_paged": "prefill_lane",
+                "lane_verify_paged": "verify_lanes",
+                "kv_page_copy": "kv_page_copy",
                 "score": "score",
             }.get(key[0], key[0])
         return "prefill"  # plain (t, greedy, window) keys

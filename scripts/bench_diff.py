@@ -46,6 +46,10 @@ WATCHED: dict[str, str] = {
     # its history re-prefills (ISSUE 12; generous threshold headroom is
     # the --threshold flag's job, not this table's)
     "SERVING.resilience.p99_gap_ms_recovery": "lower",
+    # the oversubscription tax: steady-state decode cadence while 2x the
+    # lane count of streams park/resume through the pool-native path
+    # (ISSUE 16)
+    "SERVING.oversubscription.tpot_ms_p50": "lower",
 }
 
 

@@ -936,7 +936,7 @@ def test_debug_compile_endpoint(obs_server):
     for p in programs:
         assert p["kind"] in (
             "prefill", "prefill_lane", "decode_block", "decode_lanes",
-            "score", "kv_adopt", "kv_publish",
+            "score", "kv_adopt", "kv_publish", "kv_page_copy",
         )
         assert p["origin"] in ("dispatch", "prefetch", "prefetch-failed")
         assert p["cost"] == "unavailable" or p["cost"]["bytes_accessed"] >= 0

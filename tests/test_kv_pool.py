@@ -217,7 +217,9 @@ class _StubEngine:
     """Host-accounting-only stand-in: the manager's match/publish
     bookkeeping races need no device to reproduce."""
 
-    def init_kv_pool(self, page_size, n_pages):
+    kv_pool_epoch = 0
+
+    def init_kv_pool(self, page_size, n_pages, native=False):
         return n_pages
 
     def kv_adopt(self, lane, pages):
@@ -546,10 +548,12 @@ def test_manager_dedup_cow_and_eviction(tiny_model):
     kv.check()
 
 
-def test_manager_publish_failure_resets_accounting(tiny_model, monkeypatch):
-    """A failed publish dispatch (donated pool buffer) must drop the
-    host-side accounting with it instead of trusting unknown device
-    contents — and must not propagate into the scheduler."""
+def test_manager_publish_failure_narrows_to_culprit(tiny_model, monkeypatch):
+    """A TRANSIENT publish-dispatch failure (pool epoch unchanged: the
+    donated buffer was never touched) must release only that publish's
+    freshly-allocated pages — survivors' stored prefixes stay intact
+    and matchable. Only a POISONING failure (the engine guard rebuilt
+    the pool, epoch moved) drops the whole host accounting."""
     from dllama_tpu.kv.manager import PagedKVManager
     from dllama_tpu.runtime.engine import InferenceEngine
 
@@ -560,6 +564,8 @@ def test_manager_publish_failure_resets_accounting(tiny_model, monkeypatch):
     A = [10 + i for i in range(8)]
     e.prefill_lane(0, A + [9], pos0=0)
     assert kv.publish(0, A) == 2
+    pa = kv.tree.match(A).pages
+    used0 = kv.pool.stats().used
 
     def boom(*a, **k):
         raise RuntimeError("injected publish failure")
@@ -567,5 +573,21 @@ def test_manager_publish_failure_resets_accounting(tiny_model, monkeypatch):
     monkeypatch.setattr(e, "kv_publish", boom)
     B = [50 + i for i in range(8)]
     assert kv.publish(0, B) == 0  # swallowed, not raised
+    # survivor intact: A's leaf and pages untouched, B's fresh pages freed
+    assert kv.tree.match(A).n_tokens == 8 and kv.tree.match(A).pages == pa
+    assert kv.pool.stats().used == used0
+    assert kv.tree.match(B).n_tokens == 0
+    kv.check()
+
+    # poisoning failure: the dispatch guard rebuilt the pool buffer and
+    # bumped the epoch — every page's device contents are gone, so the
+    # host accounting (A included) must drop with them
+    def boom_poison(*a, **k):
+        e.kv_pool_epoch += 1
+        raise RuntimeError("injected poisoning failure")
+
+    monkeypatch.setattr(e, "kv_publish", boom_poison)
+    C = [90 + i for i in range(8)]
+    assert kv.publish(0, C) == 0
     assert kv.tree.n_pages == 0 and kv.pool.stats().used == 0  # full reset
     kv.check()
