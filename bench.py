@@ -1006,6 +1006,156 @@ def _serving_smoke(n_clients: int) -> dict:
         ),
     }
 
+    # replica fleet (ISSUE 17): 2-replica in-process topology behind the
+    # prefix-affinity router. Three rounds on a shared-prefix workload:
+    # random routing vs affinity routing (each round uses its OWN shared
+    # prefix so neither inherits the other's radix warmth — the prefix
+    # hit-rate gap is the routing policy, not cache history), then a
+    # seeded replica-kill round where every stream must still complete
+    # through mid-stream failover. The obs registry is process-global so
+    # both routers share metric families; every number is a pre/post
+    # delta around its own round.
+    from dllama_tpu.fleet.launch import launch_inprocess_fleet
+    from dllama_tpu.fleet.router import serve_router
+
+    fleet_h = launch_inprocess_fleet(
+        model_path, tok_path, n_replicas=2, batch_size=2,
+    )
+    rand_srv = serve_router(
+        fleet_h.registry, Tokenizer(tok_path), host="127.0.0.1", port=0,
+        routing="random", stall_timeout_s=30.0, start_poller=False,
+    )
+    threading.Thread(  # dlint: disable=thread-hygiene — serve_forever exits at rand_srv.shutdown() below; no handle needed
+        target=rand_srv.serve_forever, daemon=True,
+        name="dllama-bench-fleet-random",
+    ).start()
+    fleet_port = fleet_h.router.server_address[1]
+    rand_port = rand_srv.server_address[1]
+    fleet_n = 6
+
+    def fleet_round(port_: int, tag: str) -> dict:
+        """1 warmup + fleet_n concurrent unary requests sharing a long
+        system prompt unique to this round; returns goodput + hit deltas."""
+        # byte-level tokenizer: keep prompt + template well under the
+        # tiny model's seq_len 256
+        sysmsg = f"Shared fleet preamble for round {tag}. " * 2
+
+        def one(i: int, out: dict) -> None:
+            conn = http.client.HTTPConnection("127.0.0.1", port_, timeout=300)
+            conn.request(
+                "POST", "/v1/chat/completions",
+                json.dumps({
+                    "messages": [
+                        {"role": "system", "content": sysmsg},
+                        {"role": "user", "content": f"fleet q{i}"},
+                    ],
+                    "max_tokens": 8, "temperature": 0.0,
+                }),
+                {"Content-Type": "application/json"},
+            )
+            r = conn.getresponse()
+            body = json.loads(r.read().decode("utf-8"))
+            if r.status == 200:
+                out[i] = body["usage"]["completion_tokens"]
+            conn.close()
+
+        one(0, {})  # warmup publishes this round's prefix on its replica
+        pre = scrape_port(port_)
+        t0_ = time.perf_counter()
+        outs: dict = {}
+        ths = [
+            threading.Thread(
+                target=one, args=(i, outs), daemon=True,
+                name=f"dllama-bench-fleet-{tag}-{i}",
+            )
+            for i in range(1, fleet_n + 1)
+        ]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        wall = time.perf_counter() - t0_
+        post = scrape_port(port_)
+
+        def delta(name: str) -> float:
+            return metric_value(post, name) - metric_value(pre, name)
+
+        return {
+            "completed": len(outs),
+            "goodput_tok_s": round(sum(outs.values()) / wall, 2),
+            "affinity_hit_rate": round(
+                delta("dllama_router_affinity_hits_total") / fleet_n, 3
+            ),
+            "prefix_cache_hits": int(delta("dllama_prefix_cache_hits_total")),
+        }
+
+    fleet_random = fleet_round(rand_port, "rand")
+    fleet_affinity = fleet_round(fleet_port, "aff")
+    rand_srv.shutdown()
+
+    # seeded kill round: 4 greedy streams while the fault plane drops two
+    # of them mid-flush on r0 — the router must resume each on r1 and the
+    # client side must still read a finish_reason (completion rate 1.0;
+    # byte-identity is asserted in tests/test_fleet.py where the baseline
+    # bytes are captured)
+    kill_done = [False] * 4
+
+    def kill_stream(i: int) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", fleet_port, timeout=300)
+        conn.request(
+            "POST", "/v1/chat/completions",
+            json.dumps({
+                "messages": [{"role": "user", "content": f"kill round {i}"}],
+                "max_tokens": 12, "stream": True, "temperature": 0.0,
+            }),
+            {"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        raw = r.read().decode("utf-8")
+        conn.close()
+        kill_done[i] = (
+            '"finish_reason": "' in raw or '"finish_reason":"' in raw
+        )
+
+    fr_state = fleet_h.router.state
+    victim = fr_state.route(
+        fr_state.prompt_tokens(
+            [{"role": "user", "content": "kill round 0"}]
+        )
+    ).target
+    pre_kill = scrape_port(fleet_port)
+    set_fault_plane(f"sse_flush:op={victim}:nth=2:n=2")
+    kill_threads = [
+        threading.Thread(
+            target=kill_stream, args=(i,), daemon=True,
+            name=f"dllama-bench-fleet-kill-{i}",
+        )
+        for i in range(4)
+    ]
+    for t in kill_threads:
+        t.start()
+    for t in kill_threads:
+        t.join()
+    set_fault_plane("")
+    post_kill = scrape_port(fleet_port)
+    fleet_block = {
+        "n_replicas": 2,
+        "n_requests": fleet_n,
+        "goodput_tok_s": fleet_affinity["goodput_tok_s"],
+        "affinity": fleet_affinity,
+        "random": fleet_random,
+        "kill": {
+            "n_streams": len(kill_done),
+            "completed": sum(kill_done),
+            "completion_rate": round(sum(kill_done) / len(kill_done), 3),
+            "failovers": int(
+                metric_value(post_kill, "dllama_router_failovers_total")
+                - metric_value(pre_kill, "dllama_router_failovers_total")
+            ),
+        },
+    }
+    fleet_h.close()
+
     return {
         "n_clients": n_clients,
         "n_traced": len(recs),
@@ -1027,6 +1177,7 @@ def _serving_smoke(n_clients: int) -> dict:
         "speculation": speculation,
         "resilience": resilience,
         "oversubscription": oversubscription,
+        "fleet": fleet_block,
         "slo": slo,
         "timeline": timeline,
         "series": series,

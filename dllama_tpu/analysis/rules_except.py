@@ -30,7 +30,14 @@ from typing import Iterable
 
 from .core import Finding, Rule, SourceModule
 
-SCOPED_PREFIXES = ("dllama_tpu/runtime/", "dllama_tpu/kv/")
+SCOPED_PREFIXES = (
+    "dllama_tpu/runtime/",
+    "dllama_tpu/kv/",
+    # the fleet front door's error paths (failover, spill, drain
+    # forwarding) must leave evidence from day one — a router that
+    # swallows a replica death silently defeats its own purpose
+    "dllama_tpu/fleet/",
+)
 BROAD_TYPES = {"Exception", "BaseException"}
 EVIDENCE_CALLS = {"record", "postmortem", "inc", "observe", "labels"}
 

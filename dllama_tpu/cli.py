@@ -180,6 +180,13 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                         "experts (docs/moe_decode_dedup.md); auto = on at "
                         ">= 8 decode lanes (routing-correlation study, "
                         "scripts/moe_routing_sim.py)")
+    p.add_argument("--replica-id", default=None, dest="replica_id",
+                   metavar="NAME",
+                   help="name this server instance as a fleet replica: "
+                        "reported in /v1/health and used as the chaos "
+                        "op filter so a fault spec like "
+                        "'sse_flush:op=r1:nth=3' targets one replica "
+                        "(fleet/launch.py sets it; docs/fleet.md)")
     p.add_argument("--faults", default=None, metavar="SPEC",
                    help="arm the deterministic chaos plane with a fault "
                         "schedule, e.g. 'dispatch:p=0.05:seed=7,"

@@ -1,0 +1,43 @@
+"""Replica fleet: a prefix-affinity front door over N engine replicas.
+
+The single-replica serving story (drain, shedding, structured retryable
+errors, degraded health, byte-identical recovery and park/resume) scales
+out here: a lightweight stdlib HTTP router (:mod:`.router`) fronts N
+independent ``api_server`` replicas, routing each request by
+prompt-prefix hash to the replica whose radix tree likely holds the
+prefix (:mod:`.affinity`), spilling to siblings when the target is
+degraded / draining / saturated (:mod:`.replicas`), and surviving
+replica death mid-stream by resuming the stream on a sibling with the
+already-emitted tokens as prompt prefix — the PR 12 recovery contract,
+one level up. :mod:`.launch` brings up an N-replica CPU topology for
+tests and the bench. See docs/fleet.md.
+"""
+
+from .affinity import HashRing, RoutePlan, plan_route, prefix_affinity_key
+from .replicas import (
+    DEAD,
+    DEGRADED,
+    DRAINING,
+    HEALTHY,
+    Replica,
+    ReplicaRegistry,
+    ReplicaView,
+)
+from .router import RouterState, resolve_fleet_knobs, serve_router
+
+__all__ = [
+    "HashRing",
+    "RoutePlan",
+    "plan_route",
+    "prefix_affinity_key",
+    "HEALTHY",
+    "DEGRADED",
+    "DRAINING",
+    "DEAD",
+    "Replica",
+    "ReplicaRegistry",
+    "ReplicaView",
+    "RouterState",
+    "resolve_fleet_knobs",
+    "serve_router",
+]

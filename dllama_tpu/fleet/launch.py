@@ -1,0 +1,230 @@
+"""Fleet topologies: bring up N replicas + the front door.
+
+Two modes:
+
+* :class:`InProcessFleet` — N engines + N ``api_server`` instances + one
+  router, all in this process. This is what the tests and the bench use
+  on CPU: deterministic (shared seeds), cheap to tear down, and — since
+  the obs registry and flight recorder are process-global — a single
+  ``/metrics`` scrape on ANY port already aggregates the whole fleet.
+  Each replica gets a ``replica_id`` (``r0``, ``r1``, ...) so seeded
+  chaos can target exactly one of them (``sse_flush:op=r1:nth=3``).
+* ``main()`` — the ops entry point: spawns each replica as its own
+  ``python -m dllama_tpu.runtime.api_server`` subprocess (its own
+  device footprint, its own metrics), waits for their health endpoints,
+  then runs the router in the foreground. docs/fleet.md has the
+  runbook.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+from ..tokenizer import ChatTemplateType, Tokenizer
+from .replicas import ReplicaRegistry
+from .router import resolve_fleet_knobs, serve_router
+
+
+@dataclass
+class FleetHandle:
+    """Everything a test/bench needs to drive and tear down a fleet."""
+
+    router: object                      # ThreadingHTTPServer (router)
+    replicas: list[tuple[str, object]]  # (name, ThreadingHTTPServer)
+    registry: ReplicaRegistry
+    threads: list[threading.Thread] = field(default_factory=list)
+
+    @property
+    def router_url(self) -> str:
+        return f"http://127.0.0.1:{self.router.server_address[1]}"
+
+    @property
+    def replica_urls(self) -> dict[str, str]:
+        return {
+            name: f"http://127.0.0.1:{srv.server_address[1]}"
+            for name, srv in self.replicas
+        }
+
+    def close(self) -> None:
+        self.router.shutdown()
+        self.router.server_close()  # stops the health poller too
+        for _, srv in self.replicas:
+            srv.shutdown()
+            srv.server_close()
+
+
+def launch_inprocess_fleet(
+    model_path: str,
+    tokenizer_path: str,
+    n_replicas: int = 2,
+    batch_size: int = 2,
+    chat_template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
+    engine_kwargs: dict | None = None,
+    serve_kwargs: dict | None = None,
+    router_kwargs: dict | None = None,
+) -> FleetHandle:
+    """N lane-scheduler replicas of one tiny model + the router, all on
+    127.0.0.1 ephemeral ports. Every replica decodes greedily with the
+    same seed, which is what makes mid-stream failover byte-identity
+    testable: any replica continues any sibling's stream exactly."""
+    import jax.numpy as jnp
+
+    from ..runtime.api_server import serve
+    from ..runtime.engine import InferenceEngine
+
+    if n_replicas < 1:
+        raise ValueError(f"need at least one replica, got {n_replicas}")
+    ekw = dict(
+        tp=1, dtype=jnp.float32, temperature=0.0, seed=3,
+        batch_size=batch_size,
+    )
+    ekw.update(engine_kwargs or {})
+    skw = dict(serve_kwargs or {})
+    replicas: list[tuple[str, object]] = []
+    threads: list[threading.Thread] = []
+    for i in range(n_replicas):
+        name = f"r{i}"
+        # independent Tokenizer per replica: encode is stateless but the
+        # tokenizer's own incremental decoder is not, and replicas must
+        # not share mutable state
+        tok = Tokenizer(tokenizer_path)
+        engine = InferenceEngine(model_path, tokenizer=tok, **ekw)
+        srv = serve(
+            engine, tok, host="127.0.0.1", port=0,
+            chat_template_type=chat_template_type,
+            replica_id=name, **skw,
+        )
+        t = threading.Thread(  # dlint: disable=thread-hygiene — serve_forever returns at FleetHandle.close()'s shutdown(); the daemon thread exits with it
+            target=srv.serve_forever, daemon=True,
+            name=f"fleet-replica-{name}",
+        )
+        t.start()
+        replicas.append((name, srv))
+        threads.append(t)
+    registry = ReplicaRegistry(
+        {
+            name: f"http://127.0.0.1:{srv.server_address[1]}"
+            for name, srv in replicas
+        },
+        poll_interval_s=0.5,
+    )
+    rkw = dict(
+        chat_template_type=chat_template_type,
+        stall_timeout_s=30.0,
+    )
+    rkw.update(router_kwargs or {})
+    router = serve_router(
+        registry, Tokenizer(tokenizer_path), host="127.0.0.1", port=0,
+        **rkw,
+    )
+    rt = threading.Thread(  # dlint: disable=thread-hygiene — serve_forever returns at FleetHandle.close()'s shutdown(); the daemon thread exits with it
+        target=router.serve_forever, daemon=True, name="fleet-router"
+    )
+    rt.start()
+    threads.append(rt)
+    return FleetHandle(
+        router=router, replicas=replicas, registry=registry,
+        threads=threads,
+    )
+
+
+def _wait_health(url: str, timeout_s: float = 120.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    last = "no attempt"
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(f"{url}/v1/health", timeout=5.0):
+                return
+        except OSError as e:
+            last = f"{type(e).__name__}: {e}"
+            time.sleep(0.5)
+    raise TimeoutError(f"replica at {url} never became healthy ({last})")
+
+
+def main(argv=None) -> None:
+    """Ops entry: N replica subprocesses + the router in the foreground.
+
+    python -m dllama_tpu.fleet.launch --model m.m --tokenizer t.t \\
+        --n-replicas 2 --base-port 9990 --port 9980 --batch-size 4
+    """
+    import argparse
+    import subprocess
+    import sys
+
+    from ..tokenizer import CHAT_TEMPLATE_NAMES
+
+    parser = argparse.ArgumentParser(
+        prog="dllama-tpu-fleet",
+        description="Spawn an N-replica fleet + router (docs/fleet.md)",
+    )
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--tokenizer", required=True)
+    parser.add_argument("--n-replicas", type=int, default=2)
+    parser.add_argument("--base-port", type=int, default=9990,
+                        help="replica i listens on base-port + i")
+    parser.add_argument("--port", type=int, default=9980,
+                        help="router port")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--batch-size", type=int, default=4)
+    parser.add_argument("--max-streams", type=int, default=None)
+    parser.add_argument("--chat-template", default=None,
+                        choices=sorted(CHAT_TEMPLATE_NAMES))
+    parser.add_argument("--routing", default="affinity",
+                        choices=("affinity", "random"))
+    args = parser.parse_args(argv)
+
+    procs: list[subprocess.Popen] = []
+    replicas: dict[str, str] = {}
+    try:
+        for i in range(args.n_replicas):
+            name, port = f"r{i}", args.base_port + i
+            cmd = [
+                sys.executable, "-m", "dllama_tpu.runtime.api_server",
+                "--model", args.model, "--tokenizer", args.tokenizer,
+                "--host", args.host, "--port", str(port),
+                "--batch-size", str(args.batch_size),
+                "--replica-id", name,
+            ]
+            if args.max_streams is not None:
+                cmd += ["--max-streams", str(args.max_streams)]
+            if args.chat_template:
+                cmd += ["--chat-template", args.chat_template]
+            procs.append(subprocess.Popen(cmd))
+            replicas[name] = f"http://{args.host}:{port}"
+        for url in replicas.values():
+            _wait_health(url)
+        _, _, _, poll_s = resolve_fleet_knobs()
+        registry = ReplicaRegistry(replicas, poll_interval_s=poll_s)
+        ttype = (
+            CHAT_TEMPLATE_NAMES[args.chat_template]
+            if args.chat_template
+            else ChatTemplateType.UNKNOWN
+        )
+        server = serve_router(
+            registry, Tokenizer(args.tokenizer),
+            host=args.host, port=args.port,
+            chat_template_type=ttype, routing=args.routing,
+        )
+        print(
+            f"Fleet router: http://{args.host}:{args.port}/v1/ "
+            f"({len(replicas)} replicas)"
+        )
+        try:
+            server.serve_forever()
+        finally:
+            server.server_close()
+    finally:
+        for p in procs:
+            p.terminate()  # SIGTERM = graceful drain on the replica
+        for p in procs:
+            try:
+                p.wait(timeout=90.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,854 @@
+"""The fleet front door: prefix-affinity routing + mid-stream failover.
+
+A stdlib ``ThreadingHTTPServer`` (same style as ``runtime/api_server``,
+deliberately engine-free — the router process never imports jax) that
+fronts N replicas:
+
+* **Affinity.** Each ``POST /v1/chat/completions`` is tokenized ONCE at
+  the router with exactly the replica's admission recipe (chat template
+  with ``append_generation_prompt=True``, then ``encode(is_start=True,
+  add_special_tokens=True)``); the first K token ids hash onto a
+  consistent ring (:mod:`.affinity`), so repeated and shared-prefix
+  prompts land on the replica whose radix tree holds their prefix.
+* **Spill.** The ring order is filtered through replica health
+  (:mod:`.replicas`): dead/draining/saturated siblings are skipped,
+  degraded ones demoted to last resort, and a 429/503 shed or refused
+  connection at request time moves to the next candidate. Every
+  diversion counts in ``dllama_router_spills_total{reason}``.
+* **Mid-stream failover.** Replicas stream with ``include_tokens``, so
+  every SSE chunk carries the exact generated token ids
+  (``dllama_tokens``) and their raw decoded text (``dllama_piece``).
+  When a replica dies mid-stream (EOF, stall past the watchdog read
+  timeout, or an in-stream retryable error), the router first emits the
+  catch-up delta — the exact text consumed but still held back by the
+  dead replica's EOS detector — then re-issues the request to the next
+  sibling as ``resume_tokens`` = prompt tokens + emitted tokens. The
+  sibling's recovery-admission path (radix re-match + chunked
+  re-prefill) continues the stream byte-identically under greedy
+  decoding, on the SAME client connection. docs/fleet.md spells out the
+  contract and its two edge cases (stop strings and incomplete UTF-8
+  spanning the boundary).
+
+Knobs resolve CLI-beats-env-beats-default via the ``DLLAMA_FLEET_*``
+family: ``DLLAMA_FLEET_AFFINITY_K``, ``DLLAMA_FLEET_FAILOVER_MAX``,
+``DLLAMA_FLEET_STALL_S``, ``DLLAMA_FLEET_POLL_S``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import random
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from ..obs.metrics import get_registry
+from ..obs.recorder import get_recorder
+from ..tokenizer import (
+    CHAT_TEMPLATE_NAMES,
+    ChatItem,
+    ChatTemplateGenerator,
+    ChatTemplateType,
+    Tokenizer,
+)
+from .affinity import (
+    DEFAULT_AFFINITY_K,
+    HashRing,
+    RoutePlan,
+    plan_route,
+    prefix_affinity_key,
+)
+from .replicas import ReplicaRegistry
+
+DEFAULT_FAILOVER_MAX = 3
+DEFAULT_STALL_S = 120.0
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "")
+    return float(v) if v else default
+
+
+def resolve_fleet_knobs(
+    affinity_k: int | None = None,
+    failover_max: int | None = None,
+    stall_timeout_s: float | None = None,
+    poll_interval_s: float | None = None,
+) -> tuple[int, int, float, float]:
+    """Router knob resolution: explicit value (CLI flag / constructor)
+    beats the ``DLLAMA_FLEET_*`` env knob beats the default — the same
+    ladder as the engine's lane/stream knobs."""
+    k = (
+        int(affinity_k)
+        if affinity_k is not None
+        else int(_env_float("DLLAMA_FLEET_AFFINITY_K", DEFAULT_AFFINITY_K))
+    )
+    fmax = (
+        int(failover_max)
+        if failover_max is not None
+        else int(_env_float("DLLAMA_FLEET_FAILOVER_MAX", DEFAULT_FAILOVER_MAX))
+    )
+    stall = (
+        float(stall_timeout_s)
+        if stall_timeout_s is not None
+        else _env_float("DLLAMA_FLEET_STALL_S", DEFAULT_STALL_S)
+    )
+    poll = (
+        float(poll_interval_s)
+        if poll_interval_s is not None
+        else _env_float("DLLAMA_FLEET_POLL_S", 2.0)
+    )
+    if k <= 0:
+        raise ValueError(f"affinity k must be positive, got {k}")
+    return k, max(0, fmax), stall, poll
+
+
+def _sse_write(wfile, data: str) -> None:
+    """One HTTP-chunked SSE frame (mirror of the replica server's)."""
+    raw = data.encode("utf-8")
+    wfile.write(f"{len(raw):x}\r\n".encode() + raw + b"\r\n")
+
+
+class _StreamDeath(Exception):
+    """An upstream replica's SSE stream died recoverably mid-flight:
+    EOF / broken chunking, a read stall past the watchdog timeout, or an
+    in-stream retryable error frame. The relay fails over."""
+
+
+class RouterState:
+    """Shared router state: registry + ring + tokenizer + metrics."""
+
+    def __init__(
+        self,
+        registry: ReplicaRegistry,
+        tokenizer: Tokenizer,
+        chat_template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
+        model_name: str = "dllama-fleet",
+        affinity_k: int | None = None,
+        failover_max: int | None = None,
+        stall_timeout_s: float | None = None,
+        routing: str = "affinity",
+        seed: int = 0,
+    ):
+        if routing not in ("affinity", "random"):
+            raise ValueError(f"unknown routing mode {routing!r}")
+        self.registry = registry
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.routing = routing
+        self.start_unix = time.time()
+        k, fmax, stall, _ = resolve_fleet_knobs(
+            affinity_k, failover_max, stall_timeout_s
+        )
+        self.affinity_k = k
+        self.failover_max = fmax
+        self.stall_timeout_s = stall
+        # the router's prompt rendering MUST mirror the replica's
+        # admission path token-for-token — the affinity key hashes the
+        # very ids the replica's radix tree stores (tests cross-check
+        # against the replica's reported usage.prompt_tokens)
+        stops = [
+            tokenizer.vocab[t].decode("utf-8", "replace")
+            for t in tokenizer.eos_token_ids
+        ]
+        self.template = ChatTemplateGenerator(
+            chat_template_type,
+            tokenizer.chat_template,
+            stops[0] if stops else "",
+        )
+        self.ring = HashRing(registry.names)
+        # deterministic per-request RNG stream for routing="random" (the
+        # bench's affinity-off baseline): string seeding is stable across
+        # processes, unlike hash()-seeded tuples
+        self._seed = seed
+        self._n_requests = 0
+        self._count_lock = threading.Lock()
+        self.obs = get_registry()
+        self.recorder = get_recorder()
+        self.m_requests = self.obs.counter(
+            "dllama_router_requests_total",
+            "Router requests by serving replica and outcome (ok, error, "
+            "shed, refused, died, client_gone, unavailable, ...).",
+            labelnames=("replica", "outcome"),
+        )
+        self.m_failovers = self.obs.counter(
+            "dllama_router_failovers_total",
+            "Mid-stream failovers: a replica's SSE stream died and the "
+            "router resumed it on a sibling via resume_tokens.",
+        )
+        self.m_affinity_hits = self.obs.counter(
+            "dllama_router_affinity_hits_total",
+            "Requests served by their prefix-affinity target replica "
+            "(first streamed-from replica == consistent-hash target).",
+        )
+        self.m_spills = self.obs.counter(
+            "dllama_router_spills_total",
+            "Requests diverted off their affinity target by reason "
+            "(dead, draining, saturated, degraded, shed, refused).",
+            labelnames=("reason",),
+        )
+
+    # --------------------------------------------------------------- route
+
+    def prompt_tokens(self, messages: list[dict]) -> list[int]:
+        """Tokenize a chat exactly as replica admission will."""
+        items = [
+            ChatItem(str(m["role"]), str(m["content"])) for m in messages
+        ]
+        prompt = self.template.generate(items, append_generation_prompt=True)
+        return self.tokenizer.encode(
+            prompt.content, is_start=True, add_special_tokens=True
+        )
+
+    def route(self, tokens: list[int]) -> RoutePlan:
+        """Plan the candidate order for one request. The affinity target
+        is ALWAYS the ring's choice — in routing="random" mode only the
+        try order is shuffled, so the affinity-hit metric measures the
+        same thing in both modes and the bench comparison is honest."""
+        key = prefix_affinity_key(tokens, self.affinity_k)
+        plan = plan_route(self.ring.order(key), self.registry.views())
+        if self.routing == "random" and len(plan.candidates) > 1:
+            with self._count_lock:
+                n = self._n_requests
+                self._n_requests += 1
+            rng = random.Random(f"{self._seed}:{n}")
+            plan.candidates = rng.sample(
+                plan.candidates, len(plan.candidates)
+            )
+        elif self.routing == "affinity":
+            reason = plan.spill_reason
+            if reason is not None:
+                self.m_spills.labels(reason=reason).inc()
+        return plan
+
+
+def make_router_handler(state: RouterState):
+    class RouterHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet access log
+            pass
+
+        def _json(
+            self, payload: dict, status: int = 200,
+            retry_after: int | None = None,
+        ) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header(
+                "Content-Type", "application/json; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            if retry_after is not None:
+                self.send_header("Retry-After", str(retry_after))
+            self.end_headers()
+            self.wfile.write(body)
+
+        # ------------------------------------------------------------ GET
+
+        def do_GET(self):
+            path = self.path.partition("?")[0]
+            if path == "/metrics":
+                state.obs.run_refresh_hooks()
+                body = state.obs.render().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", state.obs.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/v1/health":
+                self._json(self._fleet_health())
+            elif path == "/v1/fleet":
+                self._json(self._fleet_payload())
+            elif path == "/v1/models":
+                self._json(
+                    {
+                        "object": "list",
+                        "data": [
+                            {
+                                "id": state.model_name,
+                                "object": "model",
+                                "created": 0,
+                                "owned_by": "user",
+                            }
+                        ],
+                    }
+                )
+            elif path in ("/health", "/healthz"):
+                self._json({"status": "ok"})
+            else:
+                self.send_error(404, "Not Found")
+
+        def _fleet_health(self) -> dict:
+            views = state.registry.views()
+            states = [v.state for v in views.values()]
+            if any(s == "healthy" for s in states):
+                status = "ok"
+            elif any(s != "dead" for s in states):
+                status = "degraded"
+            else:
+                status = "unavailable"
+            return {
+                "status": status,
+                "role": "router",
+                "routing": state.routing,
+                "uptime_s": round(time.time() - state.start_unix, 3),
+                "replicas": {name: v.state for name, v in views.items()},
+            }
+
+        def _fleet_payload(self) -> dict:
+            views = state.registry.views()
+            agg = {
+                "lanes_total": sum(v.lanes for v in views.values()),
+                "in_flight": sum(v.in_flight for v in views.values()),
+                "parked": sum(v.parked for v in views.values()),
+                "max_streams": sum(
+                    v.max_streams for v in views.values()
+                ),
+                "states": {},
+            }
+            for v in views.values():
+                agg["states"][v.state] = agg["states"].get(v.state, 0) + 1
+            return {
+                "router": {
+                    "routing": state.routing,
+                    "affinity_k": state.affinity_k,
+                    "failover_max": state.failover_max,
+                    "stall_timeout_s": state.stall_timeout_s,
+                    "model": state.model_name,
+                },
+                "aggregate": agg,
+                "replicas": state.registry.snapshot(),
+            }
+
+        # ----------------------------------------------------------- POST
+
+        def do_POST(self):
+            path, _, query = self.path.partition("?")
+            if path == "/v1/drain":
+                self._drain(parse_qs(query))
+                return
+            if path != "/v1/chat/completions":
+                self.send_error(404, "Not Found")
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                messages = body.get("messages")
+                if not isinstance(messages, list) or not messages:
+                    raise ValueError("messages required")
+                tokens = state.prompt_tokens(messages)
+            except (ValueError, KeyError, TypeError) as e:
+                state.m_requests.labels(
+                    replica="none", outcome="bad_request"
+                ).inc()
+                self._json({"error": {"message": f"bad request: {e}"}}, 400)
+                return
+            plan = state.route(tokens)
+            if not plan.candidates:
+                state.m_requests.labels(
+                    replica="none", outcome="unavailable"
+                ).inc()
+                self._json(
+                    {
+                        "error": {
+                            "message": "no replica available",
+                            "retryable": True,
+                            "retry_after_s": 2,
+                        }
+                    },
+                    503,
+                    retry_after=2,
+                )
+                return
+            if body.get("stream"):
+                self._relay_stream(body, tokens, plan)
+            else:
+                self._relay_unary(body, plan)
+
+        def _drain(self, params: dict) -> None:
+            """POST /v1/drain?replica=NAME — forward the drain and stop
+            routing to the replica immediately (docs/fleet.md runbook)."""
+            name = (params.get("replica") or [None])[0]
+            if name is None or name not in state.registry.names:
+                self._json(
+                    {
+                        "error": {
+                            "message": "replica query param required, one "
+                            f"of {sorted(state.registry.names)}",
+                        }
+                    },
+                    400,
+                )
+                return
+            url = state.registry.url_of(name)
+            try:
+                req = urllib.request.Request(
+                    f"{url}/v1/drain", data=b"", method="POST"
+                )
+                with urllib.request.urlopen(req, timeout=10.0) as r:
+                    payload = json.loads(r.read())
+            except (OSError, ValueError) as e:
+                state.recorder.record(
+                    "router_drain_error", replica=name,
+                    error=f"{type(e).__name__}: {e}",
+                )
+                self._json(
+                    {"error": {"message": f"drain forward failed: {e}"}},
+                    502,
+                )
+                return
+            state.registry.mark_draining(name)
+            state.recorder.record("router_drain", replica=name)
+            payload["replica"] = name
+            self._json(payload)
+
+        # --------------------------------------------------- unary relay
+
+        def _relay_unary(self, body: dict, plan: RoutePlan) -> None:
+            """Non-stream requests: whole-request retry on the next
+            candidate (greedy/seeded requests reproduce; an unseeded
+            sampled request re-samples — documented in docs/fleet.md)."""
+            for name in plan.candidates:
+                res = self._open_upstream(
+                    state.registry.url_of(name), body
+                )
+                kind = res[0]
+                if kind == "refused":
+                    state.registry.mark_dead(name, "connect")
+                    state.m_spills.labels(reason="refused").inc()
+                    state.m_requests.labels(
+                        replica=name, outcome="refused"
+                    ).inc()
+                    continue
+                if kind == "stream":  # impossible for stream=False
+                    res[1].close()
+                    state.m_requests.labels(
+                        replica=name, outcome="protocol"
+                    ).inc()
+                    continue
+                _, status, data, retry_after = res
+                if status in (429, 503):
+                    state.m_spills.labels(reason="shed").inc()
+                    state.m_requests.labels(
+                        replica=name, outcome="shed"
+                    ).inc()
+                    continue
+                state.m_requests.labels(
+                    replica=name,
+                    outcome="ok" if status == 200 else f"http_{status}",
+                ).inc()
+                if name == plan.target:
+                    state.m_affinity_hits.inc()
+                self.send_response(status)
+                self.send_header(
+                    "Content-Type", "application/json; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            state.m_requests.labels(
+                replica="none", outcome="unavailable"
+            ).inc()
+            self._json(
+                {
+                    "error": {
+                        "message": "all replicas refused or shed",
+                        "retryable": True,
+                        "retry_after_s": 2,
+                    }
+                },
+                503,
+                retry_after=2,
+            )
+
+        # -------------------------------------------------- stream relay
+
+        def _open_upstream(self, base_url: str, req_body: dict):
+            """POST to a replica. Returns one of
+            ``("stream", conn, resp)`` (SSE accepted),
+            ``("response", status, body_bytes, retry_after)``, or
+            ``("refused", reason)`` (connect/send failure)."""
+            u = urlsplit(base_url)
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port, timeout=state.stall_timeout_s
+            )
+            try:
+                conn.request(
+                    "POST",
+                    "/v1/chat/completions",
+                    json.dumps(req_body),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+            except OSError as e:
+                conn.close()
+                return ("refused", f"{type(e).__name__}: {e}")
+            ctype = resp.getheader("Content-Type") or ""
+            if resp.status == 200 and "text/event-stream" in ctype:
+                return ("stream", conn, resp)
+            try:
+                data = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                return ("refused", f"{type(e).__name__}: {e}")
+            retry_after = resp.getheader("Retry-After")
+            conn.close()
+            return ("response", resp.status, data, retry_after)
+
+        def _client_chunk(self, obj: dict) -> None:
+            _sse_write(self.wfile, f"data: {json.dumps(obj)}\r\n\r\n")
+
+        def _client_done(self) -> None:
+            _sse_write(self.wfile, "data: [DONE]\r\n\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+
+        def _sse_headers(self) -> None:
+            self.send_response(200)
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header(
+                "Content-Type", "text/event-stream; charset=utf-8"
+            )
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+        def _synth_delta(self, text: str) -> dict:
+            """A router-synthesized catch-up chunk: the exact text the
+            dead replica had consumed but not yet flushed."""
+            return {
+                "id": "cmpl-1",
+                "object": "chat.completion.chunk",
+                "created": int(time.time()),
+                "model": state.model_name,
+                "choices": [
+                    {
+                        "index": 0,
+                        "finish_reason": None,
+                        "delta": {"role": "assistant", "content": text},
+                    }
+                ],
+            }
+
+        def _relay_frames(self, resp, book: dict) -> None:
+            """Relay one upstream SSE stream until ``[DONE]``, keeping
+            the failover books: ``emitted`` (generated token ids),
+            ``exact`` (exact consumed text via dllama_piece) and
+            ``relayed`` (delta text the client has). Raises _StreamDeath
+            on EOF / stall / retryable error; raises OSError if OUR
+            client's socket fails."""
+            while True:
+                try:
+                    line = resp.readline()
+                except (
+                    http.client.HTTPException,
+                    ConnectionError,
+                    TimeoutError,
+                    OSError,
+                    ValueError,
+                ) as e:
+                    raise _StreamDeath(
+                        f"read_{type(e).__name__}"
+                    ) from e
+                if not line:
+                    raise _StreamDeath("eof")
+                line = line.strip()
+                if not line.startswith(b"data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == b"[DONE]":
+                    if book.get("finish") is None and "error" not in book:
+                        # a stream must end with a finish chunk or an
+                        # error frame; a bare [DONE] is a broken replica
+                        raise _StreamDeath("no_finish")
+                    return
+                try:
+                    obj = json.loads(payload)
+                except ValueError as e:
+                    raise _StreamDeath("bad_frame") from e
+                if "error" in obj:
+                    err = obj["error"]
+                    if err.get("retryable"):
+                        raise _StreamDeath(
+                            f"retryable:{err.get('message', '')}"
+                        )
+                    # non-retryable (client-caused): forward verbatim,
+                    # the stream is over
+                    book["error"] = err
+                    self._client_chunk({"error": err})
+                    continue
+                tokens = obj.pop("dllama_tokens", None)
+                piece = obj.pop("dllama_piece", None)
+                choice = (obj.get("choices") or [{}])[0]
+                text = (choice.get("delta") or {}).get("content")
+                # books BEFORE the client write: a dead client aborts
+                # the whole request anyway (OSError propagates)
+                if tokens:
+                    book["emitted"].extend(int(t) for t in tokens)
+                if piece:
+                    book["exact"] += piece
+                if choice.get("finish_reason"):
+                    book["finish"] = choice["finish_reason"]
+                self._client_chunk(obj)
+                if text:
+                    book["relayed"] += text
+
+        def _relay_stream(
+            self, body: dict, prompt_tokens: list[int], plan: RoutePlan
+        ) -> None:
+            """Stream with mid-stream failover (the tentpole headline);
+            see the module docstring for the resume contract."""
+            book: dict = {"emitted": [], "exact": "", "relayed": ""}
+            max_tokens = int(body.get("max_tokens", -1) or -1)
+            started = False     # SSE headers sent to OUR client
+            first_replica = None
+            failovers = 0
+            try:
+                for name in plan.candidates:
+                    resuming = bool(book["emitted"])
+                    upstream = dict(body)
+                    upstream["stream"] = True
+                    upstream["include_tokens"] = True
+                    upstream.pop("resume_tokens", None)
+                    if resuming:
+                        upstream["resume_tokens"] = (
+                            prompt_tokens + book["emitted"]
+                        )
+                        upstream.pop("messages", None)
+                        if max_tokens > 0:
+                            upstream["max_tokens"] = max(
+                                1, max_tokens - len(book["emitted"])
+                            )
+                    res = self._open_upstream(
+                        state.registry.url_of(name), upstream
+                    )
+                    kind = res[0]
+                    if kind == "refused":
+                        state.registry.mark_dead(name, "connect")
+                        state.m_spills.labels(reason="refused").inc()
+                        state.m_requests.labels(
+                            replica=name, outcome="refused"
+                        ).inc()
+                        continue
+                    if kind == "response":
+                        _, status, data, _ra = res
+                        if status in (429, 503):
+                            state.m_spills.labels(reason="shed").inc()
+                            state.m_requests.labels(
+                                replica=name, outcome="shed"
+                            ).inc()
+                            continue
+                        # non-retryable upstream answer (e.g. 400): if
+                        # the client stream hasn't started, forward it;
+                        # mid-failover it terminates the stream below
+                        state.m_requests.labels(
+                            replica=name, outcome=f"http_{status}"
+                        ).inc()
+                        if not started:
+                            self.send_response(status)
+                            self.send_header(
+                                "Content-Type",
+                                "application/json; charset=utf-8",
+                            )
+                            self.send_header(
+                                "Content-Length", str(len(data))
+                            )
+                            self.end_headers()
+                            self.wfile.write(data)
+                            return
+                        break
+                    _, conn, resp = res
+                    if first_replica is None:
+                        first_replica = name
+                    if not started:
+                        self._sse_headers()
+                        started = True
+                    if resuming:
+                        # catch-up: exact consumed text the dead replica
+                        # never flushed (its detector holdback). After
+                        # this, relayed == exact and the sibling's fresh
+                        # deltas append cleanly.
+                        gap = book["exact"][len(book["relayed"]):]
+                        if gap:
+                            self._client_chunk(self._synth_delta(gap))
+                            book["relayed"] += gap
+                    try:
+                        self._relay_frames(resp, book)
+                    except _StreamDeath as death:
+                        conn.close()
+                        state.m_failovers.inc()
+                        state.m_requests.labels(
+                            replica=name, outcome="died"
+                        ).inc()
+                        state.recorder.record(
+                            "router_failover",
+                            replica=name,
+                            reason=str(death),
+                            emitted_tokens=len(book["emitted"]),
+                        )
+                        failovers += 1
+                        if failovers > state.failover_max:
+                            break
+                        continue
+                    # clean end: upstream sent finish (or a
+                    # non-retryable error frame) then [DONE]
+                    conn.close()
+                    state.m_requests.labels(
+                        replica=name,
+                        outcome="error" if "error" in book else "ok",
+                    ).inc()
+                    if first_replica == plan.target:
+                        state.m_affinity_hits.inc()
+                    self._client_done()
+                    return
+                # candidates (or the failover budget) exhausted
+                state.m_requests.labels(
+                    replica="none", outcome="unavailable"
+                ).inc()
+                if not started:
+                    self._json(
+                        {
+                            "error": {
+                                "message": "all replicas refused or shed",
+                                "retryable": True,
+                                "retry_after_s": 2,
+                            }
+                        },
+                        503,
+                        retry_after=2,
+                    )
+                    return
+                self._client_chunk(
+                    {
+                        "error": {
+                            "message": "stream lost: failover budget "
+                            "exhausted",
+                            "retryable": True,
+                        }
+                    }
+                )
+                self._client_done()
+            except OSError:
+                # OUR client went away mid-relay; the upstream replica's
+                # lane notices its own socket close via cancellation
+                state.m_requests.labels(
+                    replica=first_replica or "none",
+                    outcome="client_gone",
+                ).inc()
+                self.close_connection = True
+
+    return RouterHandler
+
+
+def serve_router(
+    registry: ReplicaRegistry,
+    tokenizer: Tokenizer,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    chat_template_type: ChatTemplateType = ChatTemplateType.UNKNOWN,
+    model_name: str = "dllama-fleet",
+    affinity_k: int | None = None,
+    failover_max: int | None = None,
+    stall_timeout_s: float | None = None,
+    routing: str = "affinity",
+    seed: int = 0,
+    start_poller: bool = True,
+) -> ThreadingHTTPServer:
+    """Build the front door. The caller runs ``serve_forever()`` (tests
+    drive it in a thread); ``server_close()`` stops the health poller."""
+    state = RouterState(
+        registry,
+        tokenizer,
+        chat_template_type=chat_template_type,
+        model_name=model_name,
+        affinity_k=affinity_k,
+        failover_max=failover_max,
+        stall_timeout_s=stall_timeout_s,
+        routing=routing,
+        seed=seed,
+    )
+    registry.poll_once()  # seed states before the first request
+    if start_poller:
+        registry.start()
+    server = ThreadingHTTPServer((host, port), make_router_handler(state))
+    server.state = state
+    inner_close = server.server_close
+
+    def _close_and_stop():
+        inner_close()
+        registry.stop()
+
+    server.server_close = _close_and_stop
+    return server
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="dllama-tpu-router",
+        description="Prefix-affinity fleet router (docs/fleet.md)",
+    )
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9980)
+    parser.add_argument(
+        "--replica", action="append", required=True, metavar="NAME=URL",
+        help="replica endpoint, repeatable: r0=http://127.0.0.1:9990",
+    )
+    parser.add_argument("--tokenizer", required=True)
+    parser.add_argument(
+        "--chat-template", default=None,
+        choices=sorted(CHAT_TEMPLATE_NAMES),
+    )
+    parser.add_argument("--model-name", default="dllama-fleet")
+    parser.add_argument("--affinity-k", type=int, default=None)
+    parser.add_argument("--failover-max", type=int, default=None)
+    parser.add_argument("--stall-timeout-s", type=float, default=None)
+    parser.add_argument(
+        "--routing", default="affinity", choices=("affinity", "random")
+    )
+    args = parser.parse_args(argv)
+
+    replicas = {}
+    for spec in args.replica:
+        name, sep, url = spec.partition("=")
+        if not sep or not name or not url:
+            raise SystemExit(f"--replica must be NAME=URL, got {spec!r}")
+        replicas[name] = url.rstrip("/")
+    _, _, _, poll_s = resolve_fleet_knobs()
+    registry = ReplicaRegistry(replicas, poll_interval_s=poll_s)
+    tok = Tokenizer(args.tokenizer)
+    ttype = (
+        CHAT_TEMPLATE_NAMES[args.chat_template]
+        if args.chat_template
+        else ChatTemplateType.UNKNOWN
+    )
+    server = serve_router(
+        registry,
+        tok,
+        host=args.host,
+        port=args.port,
+        chat_template_type=ttype,
+        model_name=args.model_name,
+        affinity_k=args.affinity_k,
+        failover_max=args.failover_max,
+        stall_timeout_s=args.stall_timeout_s,
+        routing=args.routing,
+    )
+    print(
+        f"Router URL: http://localhost:{server.server_address[1]}/v1/ "
+        f"({len(replicas)} replicas, routing={args.routing})"
+    )
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+
+
+if __name__ == "__main__":
+    main()

@@ -165,6 +165,16 @@ class InferenceParams:
     # under queue pressure or a degraded engine, "low" sheds first,
     # "high" last — the reason-tagged 429/503 + Retry-After path
     priority: str = "normal"
+    # fleet failover (docs/fleet.md): a raw token history replaces the
+    # chat template + tokenizer entirely — the router re-issues a dead
+    # replica's stream with prompt + already-emitted tokens, and the
+    # recovery-admission path (radix re-match + chunked re-prefill)
+    # continues it byte-identically (greedy). Lane scheduler only.
+    resume_tokens: list[int] | None = None
+    # attribute each SSE delta with the exact generated token ids and
+    # their raw decoded piece text (dllama_tokens / dllama_piece chunk
+    # fields) so a router can reconstruct the token history mid-stream
+    include_tokens: bool = False
 
 
 class LaneJob:
@@ -206,6 +216,12 @@ class _LaneState:
     # is the pending token whose row is written by the next decode step.
     # _finish publishes history[:pos] into the shared page pool.
     history: list = field(default_factory=list)
+    # include_tokens attribution: (token id, raw piece text) consumed
+    # since the last flushed delta. The EOS detector's holdback means a
+    # flushed delta's TEXT can lag the consumed tokens; the tape carries
+    # the exact ids + piece text so each delta event reports both, and a
+    # fleet router can rebuild the full token history at any flush point
+    tape: list = field(default_factory=list)
     # timeline span covering the lane's whole decode stretch (admission
     # done -> finish); the request-attributed backbone of the timeline
     decode_span: object = None
@@ -835,11 +851,29 @@ class LaneScheduler:
             self._resume_parked(lane, job, ls0)
             return
         try:
-            items = [ChatItem(m.role, m.content) for m in p.messages]
-            prompt = state.template.generate(items, append_generation_prompt=True)
-            tokens = tok.encode(
-                prompt.content, is_start=True, add_special_tokens=True
-            )
+            if p.resume_tokens is not None:
+                # fleet mid-stream failover (docs/fleet.md): the router
+                # replays a dead sibling's fed history (prompt +
+                # already-emitted tokens) as raw ids — no template, no
+                # tokenizer. The radix match + chunked prefill below
+                # treat it like any other prompt, so a shared prefix
+                # adopts from the pool and the stream continues
+                # byte-identically (greedy) from tokens[-1].
+                if len(p.resume_tokens) < 2:
+                    raise ValueError(
+                        "resume_tokens needs at least 2 token ids"
+                    )
+                tokens = [int(t) for t in p.resume_tokens]
+                public_prompt = ""
+            else:
+                items = [ChatItem(m.role, m.content) for m in p.messages]
+                prompt = state.template.generate(
+                    items, append_generation_prompt=True
+                )
+                tokens = tok.encode(
+                    prompt.content, is_start=True, add_special_tokens=True
+                )
+                public_prompt = prompt.public_prompt or ""
             start_pos, adopt_pages = 0, []
             if self.kv is not None:
                 # match retains the pages for this lane immediately —
@@ -883,7 +917,7 @@ class LaneScheduler:
                 cursor=start_pos,
                 prompt_end=prompt_end,
                 max_pos=max_pos,
-                public_prompt=prompt.public_prompt or "",
+                public_prompt=public_prompt,
                 start_pos=start_pos,
                 adopt_pages=adopt_pages,
             )
@@ -1228,12 +1262,31 @@ class LaneScheduler:
             if ttft is not None:
                 self.state.m_ttft.observe(ttft)
         piece = ls.decoder.decode(t)
+        if ls.job.params.include_tokens:
+            ls.tape.append((t, piece or ""))
         eos_type = ls.detector.append(t, piece)
         if eos_type in (EosResult.NOT_EOS, EosResult.EOS):
             delta = ls.detector.get_delta()
             if delta:
                 ls.job.buffer += delta
-                ls.job.events.put(("delta", delta))
+                if ls.job.params.include_tokens:
+                    # attribute the flush with the exact consumed tokens:
+                    # cumulative `tokens` across deltas == the generated
+                    # history, cumulative `piece` == its exact text (the
+                    # delta text lags by the detector's holdback)
+                    ls.job.events.put(
+                        (
+                            "delta",
+                            {
+                                "text": delta,
+                                "tokens": [tid for tid, _ in ls.tape],
+                                "piece": "".join(px for _, px in ls.tape),
+                            },
+                        )
+                    )
+                    ls.tape = []
+                else:
+                    ls.job.events.put(("delta", delta))
             ls.detector.reset()
         if eos_type == EosResult.EOS:
             self._finish(lane, "stop")
@@ -1449,10 +1502,14 @@ class ApiState:
         retry_max: int = 3,
         retry_backoff_ms: int = 5,
         max_queue_depth: int = 0,
+        replica_id: str | None = None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
+        # fleet identity (docs/fleet.md): names this replica in
+        # /v1/health and scopes chaos injection (sse_flush op filter)
+        self.replica_id = replica_id
         self.start_unix = time.time()
         # resilience knobs (resolve_resilience_knobs): the scheduler reads
         # the retry policy off this state; admission_decision() reads the
@@ -1756,6 +1813,14 @@ class ApiState:
         else:
             active = 1 if self.lock.locked() else 0
             queued = 0
+        if sched is not None:
+            admitting = len(sched.admitting)
+            parked = sched._n_parked
+            max_streams = max(sched.max_streams, total)
+        else:
+            admitting = 0
+            parked = 0
+            max_streams = 1
         payload = {
             "status": "ok",
             "model": self.model_name,
@@ -1767,7 +1832,23 @@ class ApiState:
             },
             "queue_depth": queued,
             "cache_epoch": self.engine.cache_epoch,
+            # router-facing capacity (docs/fleet.md): what a front door
+            # needs for admission-aware spill decisions — the stream
+            # ceiling, everything currently holding a slot toward it,
+            # and whether the pool is native (parks/resumes are cheap)
+            "capacity": {
+                "lanes": total,
+                "max_streams": max_streams,
+                "in_flight": active + admitting + parked + queued,
+                "parked": parked,
+                "kv_native": bool(
+                    self.kv_manager is not None
+                    and getattr(self.kv_manager, "native", False)
+                ),
+            },
         }
+        if self.replica_id is not None:
+            payload["replica"] = self.replica_id
         reasons = self.degraded_reasons()
         wd = self.watchdog
         if wd is not None and wd.degraded:
@@ -1838,7 +1919,11 @@ class ApiState:
             t.start()
         return {
             "status": "draining",
+            # the streams still running RIGHT NOW plus whether the drain
+            # already finished — a rolling restart polls this endpoint
+            # until drained flips true (docs/fleet.md runbook)
             "in_flight": in_flight,
+            "drained": self.drained.is_set(),
             "since_unix": self.draining_since,
         }
 
@@ -1861,6 +1946,17 @@ class ApiState:
             time.sleep(0.05)
         self.spans.flush()
         self.recorder.record("drain_complete")
+        # the rolling-restart poll target: in-flight hit zero, sinks are
+        # flushed, the process is safe to replace (drain_s from the
+        # POST /v1/drain that started the drain)
+        since = self.draining_since
+        self.recorder.record(
+            "drained",
+            in_flight=0,
+            drain_s=(
+                round(time.time() - since, 3) if since is not None else 0.0
+            ),
+        )
         self.drained.set()
 
     # -- completion ------------------------------------------------------
@@ -2354,6 +2450,20 @@ def make_handler(state: ApiState):
             if state.scheduler is not None:
                 self._complete_lanes(params)
                 return
+            if params.resume_tokens is not None:
+                # the serialized (batch_size == 1) path has no
+                # recovery-admission machinery; a resume there would
+                # silently retokenize — refuse instead
+                self._json(
+                    {
+                        "error": {
+                            "message": "resume_tokens requires the lane "
+                            "scheduler (batch_size > 1)",
+                        }
+                    },
+                    400,
+                )
+                return
             span = state.tracer.span(path="single")
             with state.lock:
                 # queue wait on this path is the engine-lock wait
@@ -2446,7 +2556,26 @@ def make_handler(state: ApiState):
                     while True:
                         kind, payload = job.events.get()
                         if kind == "delta":
-                            chunk = _chunk_payload(state, payload, stop=False)
+                            # include_tokens deltas arrive as dicts with
+                            # exact token/piece attribution; plain deltas
+                            # (and the public-prompt echo) stay strings
+                            if isinstance(payload, dict):
+                                chunk = _chunk_payload(
+                                    state, payload["text"], stop=False
+                                )
+                                chunk["dllama_tokens"] = payload["tokens"]
+                                chunk["dllama_piece"] = payload["piece"]
+                            else:
+                                chunk = _chunk_payload(
+                                    state, payload, stop=False
+                                )
+                                if params.include_tokens:
+                                    # prompt-echo text: no generated
+                                    # tokens back it (they are already in
+                                    # the prompt), but the piece field
+                                    # keeps exact-text accounting whole
+                                    chunk["dllama_tokens"] = []
+                                    chunk["dllama_piece"] = payload
                             # one span per SSE frame: a slow client's
                             # socket backpressure shows up on the http
                             # track of the timeline, not as engine time
@@ -2459,7 +2588,13 @@ def make_handler(state: ApiState):
                                 # indistinguishable from a flush failure,
                                 # so inject it AS one (exercises the
                                 # cancel path below)
-                                fault = get_fault_plane().draw("sse_flush")
+                                # `op` scopes the injection to one
+                                # replica (sse_flush:op=r1:...) so fleet
+                                # chaos can kill a single replica's
+                                # streams while its siblings stay clean
+                                fault = get_fault_plane().draw(
+                                    "sse_flush", op=state.replica_id
+                                )
                                 if fault is not None:
                                     raise OSError(str(fault))
                                 _sse_write(
@@ -2496,8 +2631,13 @@ def make_handler(state: ApiState):
                 except OSError:
                     # client went away: tell the scheduler to stop paying
                     # for this lane (the serialized path aborts via the
-                    # emit exception; this is the lane-mode equivalent)
+                    # emit exception; this is the lane-mode equivalent).
+                    # The chunked body is unterminated, so this keep-alive
+                    # connection can never carry another request — close
+                    # it, which is also what lets a fleet router observe
+                    # the death as EOF instead of a stalled read
                     job.cancelled = True
+                    self.close_connection = True
                 return
             finish_reason = "stop"
             while True:
@@ -2579,9 +2719,23 @@ def make_handler(state: ApiState):
                 top_p=state.default_top_p,
                 stop=[],
             )
-            params.messages = [
-                ChatMessage(m["role"], m["content"]) for m in body["messages"]
-            ]
+            if body.get("resume_tokens") is not None:
+                # fleet failover resume: a raw fed-token history stands in
+                # for the chat messages (lane path only; see do_POST)
+                params.resume_tokens = [
+                    int(t) for t in body["resume_tokens"]
+                ]
+                params.messages = [
+                    ChatMessage(m["role"], m["content"])
+                    for m in body.get("messages", [])
+                ]
+            else:
+                params.messages = [
+                    ChatMessage(m["role"], m["content"])
+                    for m in body["messages"]
+                ]
+            if "include_tokens" in body:
+                params.include_tokens = bool(body["include_tokens"])
             if "stream" in body:
                 params.stream = bool(body["stream"])
             if "temperature" in body:
@@ -2631,6 +2785,7 @@ def serve(
     retry_backoff_ms: int | None = None,
     max_queue_depth: int | None = None,
     faults: str | None = None,
+    replica_id: str | None = None,
 ):
     block, chunk = resolve_lane_knobs(lane_block_size, admission_chunk)
     page_size, pool_pages, native = resolve_kv_knobs(
@@ -2665,6 +2820,7 @@ def serve(
         retry_max=r_max,
         retry_backoff_ms=r_backoff,
         max_queue_depth=q_depth,
+        replica_id=replica_id,
     )
     if postmortem_dir:
         # a crashed scheduler loop / engine step dumps the event ring here
@@ -2780,6 +2936,7 @@ def main(argv=None) -> None:
                 retry_backoff_ms=args.retry_backoff_ms,
                 max_queue_depth=args.max_queue_depth,
                 faults=args.faults,
+                replica_id=args.replica_id,
             )
             _install_drain_handler(server)
             server.serve_forever()

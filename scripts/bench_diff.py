@@ -50,6 +50,10 @@ WATCHED: dict[str, str] = {
     # lane count of streams park/resume through the pool-native path
     # (ISSUE 16)
     "SERVING.oversubscription.tpot_ms_p50": "lower",
+    # the fleet front door's delivered rate on the shared-prefix round:
+    # a drop here means affinity routing stopped landing prompts on the
+    # replica that already holds their prefix (ISSUE 17)
+    "SERVING.fleet.goodput_tok_s": "higher",
 }
 
 
