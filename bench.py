@@ -654,6 +654,162 @@ def _serving_smoke(n_clients: int) -> dict:
         ),
     }
 
+    # second-generation speculation (ISSUE 18): a NATURAL-LANGUAGE
+    # workload — no repeating cycle for the private n-gram index to lock
+    # onto — driven as a seeded fanout: one prime request populates the
+    # radix tree, then identical greedy requests replay sequentially, so
+    # under --speculation shared each stream anchors on the primed
+    # prefix and drafts from the previous stream's published
+    # continuation. Private n-gram acceptance stays low on this text;
+    # the shared store replays the sibling's exact accepted run, so its
+    # acceptance must come out strictly higher (the CI gate) and the
+    # amortized weight passes must beat the spec-off wall clock. The
+    # draft round reuses the tiny target checkpoint as its own resident
+    # draft model — a smoke of the draft_prefill/draft_step path, not a
+    # perf claim (a same-size draft pays target price per draft token)
+    # — and sends a NOVEL prompt per request: with nothing for either
+    # n-gram source to replay, the first verify rejects the prompt-echo
+    # draft and the cooldown re-routes the lane to the resident model.
+    # byte-level tokenizer + llama3-shaped template ≈ chars + 91 prompt
+    # tokens; keep well inside the serving model's seq_len 256 with
+    # decode room for the 48-token completions below
+    nl_prompt = (
+        "Explain how a server reuses shared prefix attention state "
+        "across requests to cut time to first token"
+    )
+    nl_novel = [
+        "Describe how a radix tree over prompt tokens lets two "
+        "requests share one cached prefix copy",
+        "Compare continuous batching with static batching for large "
+        "language model serving throughput",
+        "Summarize why paged key value memory reduces fragmentation "
+        "under many concurrent decode streams",
+        "Outline how speculative decoding verifies a cheap draft with "
+        "one batched target forward pass",
+        "Explain why tensor parallel all reduce cost grows with the "
+        "device count during token generation",
+    ]
+
+    def nl_round(mode: str, draft: str | None = None) -> dict:
+        eng_ = InferenceEngine(
+            model_path, tokenizer=tok, batch_size=n_lanes,
+            temperature=0.0,
+        )
+        srv_ = serve(
+            eng_, tok, host="127.0.0.1", port=0, admission_chunk=32,
+            kv_page_size=16, speculation=mode, spec_k=8,
+            draft_model=draft,
+        )
+        port_ = srv_.server_address[1]
+        threading.Thread(  # dlint: disable=thread-hygiene — serve_forever exits at srv_.shutdown() below; no handle needed
+            target=srv_.serve_forever, daemon=True,
+            name=f"dllama-bench-http-nl-{mode}",
+        ).start()
+
+        def one_request(prompt: str = nl_prompt) -> tuple[float, int]:
+            seen = len(srv_.state.recorder.events(kind="finish"))
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port_, timeout=300
+            )
+            t0_ = time.perf_counter()
+            conn.request(
+                "POST", "/v1/chat/completions",
+                json.dumps({
+                    "messages": [
+                        {"role": "user", "content": prompt}
+                    ],
+                    "max_tokens": 48, "stream": True,
+                    "temperature": 0.0,
+                }),
+                {"Content-Type": "application/json"},
+            )
+            for _line in conn.getresponse():
+                pass
+            wall_ = time.perf_counter() - t0_
+            conn.close()
+            ntok_ = sum(
+                f["n_completion"]
+                for f in srv_.state.recorder.events(kind="finish")[seen:]
+            )
+            return wall_, ntok_
+
+        # mode 'draft' sends a fresh novel prompt per request (n-gram
+        # starvation exercises the resident model); the other modes
+        # replay one prompt as a fanout
+        prompts_ = nl_novel if mode == "draft" else [nl_prompt] * 5
+        # sources are counted over the FULL round: the model rescue
+        # fires on the earliest requests — once the store holds one
+        # run, the common template tail lets it bridge even novel
+        # prompts, which is the ladder working, not the model failing
+        pre0_ = scrape_port(port_)
+        one_request(prompts_[0])  # prime: compiles + radix insert,
+        # timing discarded
+        # stream 2 establishes the anchor and PUBLISHES its run; under
+        # 'shared' the store only pays off from stream 3 on, so the
+        # measured window starts after one more discard
+        one_request(prompts_[1])
+        pre_ = scrape_port(port_)
+        rates_ = []
+        for p_ in prompts_[2:]:
+            wall_, ntok_ = one_request(p_)
+            if ntok_ > 0 and wall_ > 0:
+                rates_.append(ntok_ / wall_)
+        post_ = scrape_port(port_)
+        srv_.shutdown()
+        drafted_ = (
+            metric_value(post_, "dllama_spec_draft_tokens_total")
+            - metric_value(pre_, "dllama_spec_draft_tokens_total")
+        )
+        accepted_ = (
+            metric_value(post_, "dllama_spec_accepted_tokens_total")
+            - metric_value(pre_, "dllama_spec_accepted_tokens_total")
+        )
+
+        def source_delta(src: str) -> int:
+            pat = (
+                rf'^dllama_spec_source_total{{source="{src}"}} '
+                r"([0-9.eE+-]+)$"
+            )
+            pre_m = re.search(pat, pre0_, re.M)
+            post_m = re.search(pat, post_, re.M)
+            return int(
+                (float(post_m.group(1)) if post_m else 0.0)
+                - (float(pre_m.group(1)) if pre_m else 0.0)
+            )
+
+        return {
+            "tok_s": sorted(rates_)[len(rates_) // 2] if rates_ else 0.0,
+            "acceptance": accepted_ / drafted_ if drafted_ else 0.0,
+            "drafted": int(drafted_),
+            "sources": {
+                s: source_delta(s) for s in ("ngram", "shared", "draft")
+            },
+            "store_tokens": int(
+                metric_value(post_, "dllama_spec_shared_store_tokens")
+            ),
+        }
+
+    nl_off = nl_round("off")
+    nl_ngram = nl_round("ngram")
+    nl_shared = nl_round("shared")
+    nl_draft = nl_round("draft", draft=model_path)
+    speculation_nl = {
+        "tok_s_off": round(nl_off["tok_s"], 2),
+        "tok_s_ngram": round(nl_ngram["tok_s"], 2),
+        "tok_s_shared": round(nl_shared["tok_s"], 2),
+        "tok_s_draft": round(nl_draft["tok_s"], 2),
+        "accept_ngram": round(nl_ngram["acceptance"], 3),
+        "accept_shared": round(nl_shared["acceptance"], 3),
+        "accept_draft": round(nl_draft["acceptance"], 3),
+        "speedup_shared_vs_off": round(
+            nl_shared["tok_s"] / nl_off["tok_s"]
+            if nl_off["tok_s"] else 0.0, 3
+        ),
+        "shared_sources": nl_shared["sources"],
+        "draft_sources": nl_draft["sources"],
+        "shared_store_tokens": nl_shared["store_tokens"],
+    }
+
     fan_recs = [
         r for r in read_jsonl(trace_path)
         if r.get("submitted_unix", 0) >= fan_t0
@@ -1175,6 +1331,7 @@ def _serving_smoke(n_clients: int) -> dict:
         ),
         "prefix_fanout": prefix_fanout,
         "speculation": speculation,
+        "speculation_nl": speculation_nl,
         "resilience": resilience,
         "oversubscription": oversubscription,
         "fleet": fleet_block,

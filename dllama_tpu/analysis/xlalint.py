@@ -211,7 +211,8 @@ def _key_steps_tokens(key: Any, batch: int) -> tuple[int, int]:
     if isinstance(key, tuple) and key and isinstance(key[0], str):
         kind = key[0]
         n = int(key[1]) if len(key) > 1 else 1
-        if kind in ("block", "lane_block", "lane_block_paged"):
+        if kind in ("block", "lane_block", "lane_block_paged", "draft_step"):
+            # draft_step autoregresses k greedy draft-model forwards
             return n, batch
         # lane_prefill / lane_verify / score / kv_*: one forward, n wide
         return 1, n * batch
@@ -264,6 +265,10 @@ def engine_policies(engine: "InferenceEngine") -> dict:
         "kv_adopt": copy,
         "kv_publish": copy,
         "kv_page_copy": copy,
+        # resident draft model (PR 18): plain forwards over the draft
+        # checkpoint — same regather/upcast rules as the target's
+        "draft_prefill": fwd,
+        "draft_step": fwd,
     }
 
 
@@ -285,10 +290,21 @@ def _engine_program(
         return None
     family = engine._key_kind(key)
     policy = policies.get(family, FamilyPolicy())
-    cache_b = _tree_bytes(engine._cache_specs)
+    # draft-model programs run over the DRAFT checkpoint's params and
+    # its own KV cache — budget them against those trees, not the
+    # target's (a tiny draft linted against the big target's ceilings
+    # would never trip the gate)
+    draft = family in ("draft_prefill", "draft_step")
+    param_specs = (
+        engine._draft_param_specs if draft else engine._param_specs
+    )
+    cache_specs = (
+        engine._draft_cache_specs if draft else engine._cache_specs
+    )
+    cache_b = _tree_bytes(cache_specs)
     pool_b = (
         _tree_bytes(engine._kv_pool_specs)
-        if engine._kv_pool_specs is not None
+        if engine._kv_pool_specs is not None and not draft
         else 0
     )
     steps, tokens = _key_steps_tokens(key, engine.batch_size)
@@ -305,11 +321,11 @@ def _engine_program(
         family,
         steps=steps,
         tokens=tokens,
-        param_bytes=_tree_bytes(engine._param_specs),
+        param_bytes=_tree_bytes(param_specs),
         cache_bytes=cache_b,
         pool_bytes=pool_b,
-        param_elems=_tree_elems(engine._param_specs),
-        cache_elems=_tree_elems(engine._cache_specs),
+        param_elems=_tree_elems(param_specs),
+        cache_elems=_tree_elems(cache_specs),
         paged=paged,
     )
     if paged or family in ("kv_publish", "kv_page_copy"):
@@ -319,7 +335,7 @@ def _engine_program(
             else 0
         )
     else:
-        expected = _tree_nleaves(engine._cache_specs)
+        expected = _tree_nleaves(cache_specs)
     return HloProgram(
         name=str(key),
         family=family,
@@ -528,6 +544,11 @@ def build_cli_engine() -> "InferenceEngine":
     # again — the compile cache keeps the slab programs, so BOTH KV
     # paths' executables go under the lint in one run
     engine.init_kv_pool(page_size=8, native=True)
+    engine.rehearse_admission(block_size=8, spec_k=2, wait=True)
+    # resident-draft families (PR 18): the tiny model doubles as its own
+    # draft checkpoint (same tokenizer by construction), so the
+    # draft_prefill/draft_step buckets compile and go under the lint too
+    engine.init_draft_model(mp)
     engine.rehearse_admission(block_size=8, spec_k=2, wait=True)
     return engine
 

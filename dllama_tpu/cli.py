@@ -94,12 +94,18 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                         "active streams, larger = faster TTFT for the "
                         "incoming prompt")
     p.add_argument("--speculation", default=None,
-                   choices=("off", "ngram"),
-                   help="model-free speculative decoding on the lane path: "
-                        "'ngram' drafts each greedy lane's continuation "
-                        "from its own context (prompt lookup) and verifies "
-                        "k tokens in one batched dispatch, keeping output "
-                        "token-exact; temperature>0 lanes fall back to the "
+                   choices=("off", "ngram", "shared", "draft"),
+                   help="speculative decoding on the lane path: 'ngram' "
+                        "drafts each greedy lane's continuation from its "
+                        "own context (prompt lookup) and verifies k tokens "
+                        "in one batched dispatch, keeping output "
+                        "token-exact; 'shared' also publishes accepted "
+                        "runs into a cross-lane store keyed by radix-tree "
+                        "node identity, so lanes sharing a prefix draft "
+                        "from each other's continuations; 'draft' "
+                        "additionally runs a resident draft model "
+                        "(--draft-model) when both n-gram sources run "
+                        "dry; temperature>0 lanes fall back to the "
                         "normal decode block per lane (default: env "
                         "DLLAMA_SPECULATION, else off = pure bypass)")
     p.add_argument("--spec-k", type=int, default=None,
@@ -108,6 +114,15 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                         "(compiled shapes are power-of-2 bucketed; each "
                         "lane's drafter adapts below this on low "
                         "acceptance; default: env DLLAMA_SPEC_K, else 4)")
+    p.add_argument("--draft-model", default=None, dest="draft_model",
+                   metavar="PATH",
+                   help="tiny same-tokenizer checkpoint loaded as the "
+                        "resident draft model for --speculation draft: "
+                        "runs k cheap greedy steps through its own "
+                        "AOT-compiled draft_step program and its own KV "
+                        "cache; every draft is verified by the target, so "
+                        "output stays token-exact (default: env "
+                        "DLLAMA_DRAFT_MODEL)")
     p.add_argument("--tp", type=int, default=0, help="tensor-parallel chips (default: all)")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel chips: shard the KV cache's "
@@ -329,9 +344,15 @@ def load_engine(args):
     from .obs.device import compare_with_analytic, sample_device_memory
     from .obs.recorder import get_recorder
 
+    from .runtime.spec import resolve_spec_knobs
+
+    spec_mode, spec_k_val = resolve_spec_knobs(
+        getattr(args, "speculation", None), getattr(args, "spec_k", None)
+    )
     print_roofline_report(
         h, engine.weight_format, tp=tp, pp=pp,
         i8_group=engine.i8_group or 512,
+        spec_k=spec_k_val if spec_mode != "off" else 0,
     )
     # live per-chip memory vs the analytic figure: a >10% gap logs a
     # warning (leak / unplanned replication / stale analytic model)

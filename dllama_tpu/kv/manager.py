@@ -66,6 +66,10 @@ class PagedKVManager:
         self.lock = make_lock("kv.manager")
         self._lane_pages: dict[int, list[int]] = {}
         self._lane_match_tokens: dict[int, int] = {}
+        # radix anchor of each lane's last match (runtime/spec.py shared
+        # n-gram store): (node_id, matched token count), or absent when
+        # the match found nothing
+        self._lane_anchor: dict[int, tuple[int, int]] = {}
         # dashboards keep their dllama_cache_evictions_total series: the
         # ApiState hands us its handle and radix evictions feed it
         self._evict_counter = evict_counter
@@ -142,6 +146,13 @@ class PagedKVManager:
             if stale:
                 self.pool.release(stale)
             mr = self.tree.match(tokens)
+            # the anchor follows the raw token match (not the page cap):
+            # sibling grouping only needs prefix identity, not adoptable
+            # KV — a lane can share an anchor with zero reusable pages
+            if mr.anchor is not None:
+                self._lane_anchor[lane] = (mr.anchor, mr.n_tokens)
+            else:
+                self._lane_anchor.pop(lane, None)
             m = min(mr.n_tokens, len(mr.pages) * ps, len(tokens) - 1)
             if m <= 0:
                 self._lane_match_tokens[lane] = 0
@@ -221,10 +232,18 @@ class PagedKVManager:
             return [self.pool.fork(fork_src)]
         return self.pool.alloc(n)
 
+    def anchor_for(self, lane: int) -> tuple[int, int] | None:
+        """(radix node_id, matched token count) of ``lane``'s last
+        :meth:`match`, or None when nothing matched — the grouping key
+        the scheduler hands the shared n-gram drafter."""
+        with self.lock:
+            return self._lane_anchor.get(lane)
+
     def release_lane(self, lane: int) -> None:
         with self.lock:
             pages = self._lane_pages.pop(lane, None)
             self._lane_match_tokens.pop(lane, None)
+            self._lane_anchor.pop(lane, None)
             if pages:
                 self.pool.release(pages)
             if self.native:
@@ -417,6 +436,7 @@ class PagedKVManager:
             self.pool.reset()
             self._lane_pages.clear()
             self._lane_match_tokens.clear()
+            self._lane_anchor.clear()
             if self.native:
                 self.engine.clear_all_lane_pages()
             self._update_gauges_locked()
@@ -433,6 +453,7 @@ class PagedKVManager:
                 self.pool.release(pages)
             self._lane_pages.clear()
             self._lane_match_tokens.clear()
+            self._lane_anchor.clear()
             if self.native:
                 self.engine.clear_all_lane_pages()
             self._update_gauges_locked()

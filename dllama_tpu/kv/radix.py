@@ -16,6 +16,17 @@ that makes the engine's parked-lane padding rows safe).
 
 Eviction is LRU over leaves whose pages have no users beyond the tree
 itself (refcount 1 in the :class:`~dllama_tpu.kv.pool.PagePool`).
+
+**Node identity** (the shared-speculation anchor, runtime/spec.py): every
+node carries a monotonically assigned ``node_id``, and :meth:`match`
+reports the id of the deepest node whose edge contributed at least one
+matched token as ``MatchResult.anchor``.  When an edge is SPLIT the new
+head — the node that keeps the shared prefix — INHERITS the original id
+and the tail gets a fresh one, so streams that anchored on a prefix stay
+grouped under one id even after later inserts carve the edge up.  Ids are
+advisory grouping keys only: eviction retires them silently (the shared
+n-gram store ages the group out by LRU), and every draft they seed is
+verified, so a stale anchor can cost acceptance but never correctness.
 """
 
 from __future__ import annotations
@@ -33,18 +44,30 @@ class MatchResult:
     pages: List[int]              # page ids for slots 0..len(pages)-1, in slot order
     # pages may extend past n_tokens (stale tail rows — safe to adopt) and is
     # always consecutive from slot 0.
+    anchor: Optional[int] = None  # node_id of the deepest edge that matched
+    # (None when nothing matched — the root is never an anchor)
 
 
 class _Node:
-    __slots__ = ("tokens", "start", "children", "pages", "parent", "last_access")
+    __slots__ = (
+        "tokens", "start", "children", "pages", "parent", "last_access",
+        "node_id",
+    )
 
-    def __init__(self, tokens: Tuple[int, ...], start: int, parent: Optional["_Node"]) -> None:
+    def __init__(
+        self,
+        tokens: Tuple[int, ...],
+        start: int,
+        parent: Optional["_Node"],
+        node_id: int = 0,
+    ) -> None:
         self.tokens = tokens          # edge label from parent
         self.start = start            # absolute position of tokens[0]
         self.children: Dict[int, _Node] = {}
         self.pages: List[Tuple[int, int]] = []   # (slot, page_id), slot-ascending
         self.parent = parent
         self.last_access = 0
+        self.node_id = node_id        # stable grouping key (see module doc)
 
     @property
     def end(self) -> int:
@@ -56,14 +79,20 @@ class RadixTree:
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         self.page_size = page_size
-        self.root = _Node((), 0, None)
+        self.root = _Node((), 0, None, node_id=0)
         self._clock = 0
         self._n_pages = 0
+        self._next_id = 1
 
     # -- helpers -----------------------------------------------------------
     def _tick(self) -> int:
         self._clock += 1
         return self._clock
+
+    def _new_id(self) -> int:
+        nid = self._next_id
+        self._next_id += 1
+        return nid
 
     def _slot_end(self, slot: int) -> int:
         return (slot + 1) * self.page_size - 1
@@ -75,6 +104,7 @@ class RadixTree:
         node = self.root
         matched = 0
         pages: List[int] = []
+        anchor: Optional[int] = None
         while True:
             if touch:
                 node.last_access = now
@@ -93,12 +123,16 @@ class RadixTree:
                 # pages past the agreement point only carry stale tail rows.
                 pages.extend(pid for _, pid in child.pages)
                 matched += j
+                # deepest edge with >= 1 agreeing token: a PARTIAL edge
+                # match still anchors here — when the diverging stream
+                # later publishes, the split head inherits this very id
+                anchor = child.node_id
                 if touch:
                     child.last_access = now
             if j < len(edge):
                 break
             node = child
-        return MatchResult(n_tokens=matched, pages=pages)
+        return MatchResult(n_tokens=matched, pages=pages, anchor=anchor)
 
     # -- insertion ---------------------------------------------------------
     def insert(
@@ -160,7 +194,7 @@ class RadixTree:
             node.last_access = now
             child = node.children.get(tokens[pos])
             if child is None:
-                child = _Node(tuple(tokens[pos:]), pos, node)
+                child = _Node(tuple(tokens[pos:]), pos, node, self._new_id())
                 node.children[tokens[pos]] = child
                 child.last_access = now
                 node = child
@@ -178,7 +212,9 @@ class RadixTree:
                 head.last_access = now
                 if j < len(tokens) - pos:
                     # Diverged: hang the remaining suffix off the split point.
-                    rest = _Node(tuple(tokens[pos + j:]), pos + j, head)
+                    rest = _Node(
+                        tuple(tokens[pos + j:]), pos + j, head, self._new_id()
+                    )
                     head.children[tokens[pos + j]] = rest
                     rest.last_access = now
                 pos = len(tokens)
@@ -196,9 +232,13 @@ class RadixTree:
 
     def _split(self, node: _Node, offset: int) -> "_Node":
         """Split ``node``'s edge at ``offset``: node keeps the tail, a new
-        parent takes the head (and the pages whose slots end in it)."""
+        parent takes the head (and the pages whose slots end in it).  The
+        head INHERITS ``node``'s id — streams that anchored on this edge
+        matched at least its head, so the grouping key must follow the
+        shared prefix; the tail is a new, narrower identity."""
         assert 0 < offset < len(node.tokens)
-        head = _Node(node.tokens[:offset], node.start, node.parent)
+        head = _Node(node.tokens[:offset], node.start, node.parent, node.node_id)
+        node.node_id = self._new_id()
         head.last_access = node.last_access
         node.parent.children[node.tokens[0]] = head
         node.parent = head

@@ -151,7 +151,11 @@ def program_cost_ceilings(
     through a page-table gather out of the pool and scatters the new
     rows back, so its ceiling grows by ~two extra pool traversals per
     step — page indirection that costs MORE than that is exactly the
-    regression this gate exists to catch.
+    regression this gate exists to catch. Draft-model programs
+    (``draft_prefill``/``draft_step``) are plain forwards over the DRAFT
+    checkpoint's params/cache: callers pass the draft spec trees and the
+    same forward math applies (``draft_step`` autoregresses, so its
+    ``steps`` is the draft length k).
     """
     if family in ("kv_adopt", "kv_publish", "kv_page_copy"):
         return {
@@ -179,34 +183,46 @@ def program_cost_ceilings(
 
 def roofline_report(
     h: "LlmHeader", weight_format: str, tp: int = 1, pp: int = 1,
-    i8_group: int = 512
+    i8_group: int = 512, spec_k: int = 0
 ) -> dict:
     """Analytic decode roofline for this model/format/layout: weight-read
     bytes per token per chip (weights shard over tp x pp; dp/sp replicate
     them, each replica reading its own copy) and, when the backend's HBM
-    peak is known, the ms/token floor + tok/s ceiling."""
+    peak is known, the ms/token floor + tok/s ceiling. With speculation
+    on (``spec_k`` > 0) one verify dispatch — one weight pass — emits up
+    to ``spec_k + 1`` tokens, so the weight-bound ceiling scales by the
+    achieved tokens-per-weight-pass, which live decoding reports as the
+    ``dllama_spec_tokens_per_weight_pass`` gauge (floor 1.0 = nothing
+    accepted, ceiling ``spec_k + 1`` = every draft accepted)."""
     shards = max(tp, 1) * max(pp, 1)
     per_chip = weight_bytes_per_token(h, weight_format, i8_group) // shards
     peak = hbm_peak_bytes_per_s()
-    rep = {
+    rep: dict = {
         "weight_bytes_per_token_per_chip": per_chip,
         "hbm_peak_bytes_per_s": peak,
         "min_ms_per_token": None,
         "max_tok_s_per_chip": None,
+        "spec_tokens_per_pass_floor": None,
+        "spec_tokens_per_pass_ceiling": None,
     }
     if peak:
         rep["min_ms_per_token"] = per_chip / peak * 1000.0
         rep["max_tok_s_per_chip"] = peak / per_chip if per_chip else None
+    if spec_k > 0:
+        rep["spec_tokens_per_pass_floor"] = 1.0
+        rep["spec_tokens_per_pass_ceiling"] = float(spec_k + 1)
     return rep
 
 
 def print_roofline_report(
     h: "LlmHeader", weight_format: str, tp: int = 1, pp: int = 1,
-    i8_group: int = 512
+    i8_group: int = 512, spec_k: int = 0
 ) -> dict:
     """Startup roofline printout (rides next to the memory/ICI reports in
     cli.load_engine); returns the report dict it printed."""
-    rep = roofline_report(h, weight_format, tp=tp, pp=pp, i8_group=i8_group)
+    rep = roofline_report(
+        h, weight_format, tp=tp, pp=pp, i8_group=i8_group, spec_k=spec_k
+    )
     gb = rep["weight_bytes_per_token_per_chip"] / 1e9
     if rep["hbm_peak_bytes_per_s"]:
         print(
@@ -220,5 +236,11 @@ def print_roofline_report(
             f"📐 Roofline: {gb:.3f} GB weight reads/token/chip "
             f"(HBM peak unknown on the {jax.default_backend()!r} backend; "
             "no tok/s ceiling)"
+        )
+    if rep["spec_tokens_per_pass_ceiling"] is not None:
+        print(
+            f"📐 Speculation: 1 weight pass emits 1.0..."
+            f"{rep['spec_tokens_per_pass_ceiling']:.1f} tokens (k="
+            f"{spec_k}; live: dllama_spec_tokens_per_weight_pass)"
         )
     return rep

@@ -228,6 +228,10 @@ class _Family:
     def count(self):
         return self._default().count
 
+    @property
+    def sum(self):
+        return self._default().sum
+
     def percentile(self, q: float) -> float | None:
         return self._default().percentile(q)
 

@@ -85,8 +85,11 @@ from .engine import InferenceEngine
 from .faults import get_fault_plane, set_fault_plane
 from .spec import (
     DEFAULT_SPEC_K,
+    SOURCE_DRAFT,
     NgramDrafter,
+    SharedNgramStore,
     bucket_for,
+    resolve_draft_model,
     resolve_spec_knobs,
     spec_buckets,
 )
@@ -225,6 +228,11 @@ class _LaneState:
     # timeline span covering the lane's whole decode stretch (admission
     # done -> finish); the request-attributed backbone of the timeline
     decode_span: object = None
+    # warm-start carry (runtime/spec.py): a park/recovery stashes the
+    # lane's NgramDrafter here so the resume reinstalls it — learned
+    # AIMD k, private n-gram index, and shared-store publish cursor all
+    # survive instead of paying a cold-start acceptance dip
+    drafter: object = None
 
 
 @dataclass
@@ -387,15 +395,35 @@ class LaneScheduler:
         # pathological queue can't thrash park/resume without progress
         self._progress: list[int] = [0] * state.engine.batch_size
         self._n_parked = 0
-        # model-free speculation (runtime/spec.py): greedy lanes draft
-        # from their own context and verify k tokens per dispatch;
-        # "off" is a pure bypass (no drafters, no verify programs)
-        self.spec_on = speculation == "ngram"
+        # speculation mode ladder (runtime/spec.py): greedy lanes draft
+        # from their own context — plus, cumulatively, every sibling's
+        # published continuation ("shared") and a resident draft model
+        # ("draft") — and verify k tokens per dispatch; "off" is a pure
+        # bypass (no drafters, no store, no verify/draft programs)
+        self.spec_mode = speculation
+        self.spec_on = speculation != "off"
         # verify rows are 1 + k wide and parked lanes write them into
         # the padding rows, so k is capped by the lane padding
         self.spec_k = max(1, min(int(spec_k), self.engine._lane_pad - 1))
         self.spec_buckets = spec_buckets(self.spec_k)
         self.drafters: dict[int, NgramDrafter] = {}
+        # cross-lane shared n-gram store, keyed by radix anchors: only
+        # meaningful with the KV manager on (no manager -> no anchors ->
+        # drafters degrade to private-ngram behavior, store stays empty)
+        self.spec_store = (
+            SharedNgramStore()
+            if speculation in ("shared", "draft")
+            else None
+        )
+        # resident-draft-model catch-up cursors: rows [0, _draft_pos[l])
+        # of the draft cache hold lane l's verified history prefix; the
+        # epoch snapshot detects a rebuilt draft cache (cursors reset)
+        self._draft_pos: dict[int, int] = {}
+        self._draft_epoch = getattr(state.engine, "draft_cache_epoch", 0)
+        # lane -> (position, k) of this tick's draft-model propose, so
+        # the verify outcome can advance the catch-up cursor past the
+        # accepted rows instead of re-feeding them
+        self._draft_fed: dict[int, tuple[int, int]] = {}
         # admission chunk budget: at most this many prompt tokens prefill
         # per scheduler tick (0/None = the largest prefill bucket), so the
         # worst-case inter-token gap an active stream sees is one chunk +
@@ -514,6 +542,7 @@ class LaneScheduler:
             self.state.m_finished.labels(reason="error").inc()
         self.lanes[lane] = None
         self.drafters.pop(lane, None)
+        self._draft_pos.pop(lane, None)
         if self.kv is not None:
             self.kv.release_lane(lane)
 
@@ -540,6 +569,7 @@ class LaneScheduler:
         ) is not None:
             self.state.m_finished.labels(reason="error").inc()
         self.drafters.pop(lane, None)
+        self._draft_pos.pop(lane, None)
         if self.kv is not None:
             self.kv.release_lane(lane)
 
@@ -562,6 +592,7 @@ class LaneScheduler:
             # donated by decode/prefill, so stored prefixes stay valid)
             self.kv.release_all_lanes()
         self.drafters.clear()
+        self._draft_pos.clear()
         self._set_lane_gauge()
 
     def _recover(self, e: Exception, culprit: int | None) -> None:
@@ -618,6 +649,12 @@ class LaneScheduler:
                 self._finish(lane, "cancelled")
                 continue
             self.lanes[lane] = None
+            # warm-start: the drafter rides the preserved state through
+            # the recovery re-admission (its index/AIMD k are host-side
+            # truth the crash never touched); _finish_admission rebinds
+            # it to the re-matched radix anchor and reinstalls it
+            ls.drafter = self.drafters.pop(lane, None)
+            self._draft_pos.pop(lane, None)
             start_pos, pages = 0, []
             if self.kv is not None:
                 start_pos, pages = self.kv.match(lane, ls.history)
@@ -806,7 +843,10 @@ class LaneScheduler:
             self.kv.publish(lane, ls.history[: ls.pos])
             self.kv.release_lane(lane)
         self.lanes[lane] = None
-        self.drafters.pop(lane, None)
+        # warm-start (spec satellite): the drafter parks WITH the stream
+        # instead of being discarded — the resume rebinds + reinstalls it
+        ls.drafter = self.drafters.pop(lane, None)
+        self._draft_pos.pop(lane, None)
         self._progress[lane] = 0
         ls.job._park_resume = ls
         # parked = queue-visible again: a fresh queue span covers the
@@ -1108,6 +1148,19 @@ class LaneScheduler:
             del self.admitting[lane]
             self._progress[lane] = 0
             self._set_lane_gauge()
+            # warm-start (spec satellite): reinstall the drafter the
+            # park/recovery stashed on the preserved state — learned
+            # AIMD k and n-gram index intact, rebound to the re-matched
+            # radix anchor. A resume without one (e.g. speculation
+            # turned on between park and resume) builds fresh.
+            if self.spec_on and adm.resume_state.temperature <= 0.0:
+                dr = adm.resume_state.drafter
+                adm.resume_state.drafter = None
+                if not isinstance(dr, NgramDrafter):
+                    dr = self._make_drafter(lane, adm.job.span.request_id)
+                else:
+                    dr.rebind(*self._lane_anchor(lane))
+                self.drafters[lane] = dr
             if adm.from_park:
                 self._n_parked -= 1
                 state.m_streams_parked.set(self._n_parked)
@@ -1117,10 +1170,6 @@ class LaneScheduler:
                     reused_prefix_tokens=adm.start_pos,
                     n_chunks=adm.n_chunks,
                 )
-                # the park dropped the lane's drafter; greedy lanes get
-                # a fresh one (it re-primes from history on first draft)
-                if self.spec_on and adm.resume_state.temperature <= 0.0:
-                    self.drafters[lane] = NgramDrafter(k_max=self.spec_k)
             else:
                 state.m_lanes_recovered.inc()
                 state.recorder.record(
@@ -1164,7 +1213,9 @@ class LaneScheduler:
             # greedy lanes only: a sampled lane's next token is not the
             # argmax the verify pass returns, so it stays on the decode
             # block (the fallback is per-lane, not per-server)
-            self.drafters[lane] = NgramDrafter(k_max=self.spec_k)
+            self.drafters[lane] = self._make_drafter(
+                lane, job.span.request_id
+            )
         self._set_lane_gauge()
         state.recorder.record(
             "admit", lane=lane, reused_prefix_tokens=adm.start_pos,
@@ -1190,6 +1241,7 @@ class LaneScheduler:
                 self.state.m_cancellations.inc()
         job.events.put(("done", reason))
         self.drafters.pop(lane, None)
+        self._draft_pos.pop(lane, None)
         if self.kv is not None:
             # nothing publishable mid-admission; just drop page retains
             self.kv.release_lane(lane)
@@ -1236,6 +1288,7 @@ class LaneScheduler:
         )
         self.lanes[lane] = None
         self.drafters.pop(lane, None)
+        self._draft_pos.pop(lane, None)
         self._set_lane_gauge()
         with self.cv:
             self.cv.notify()
@@ -1296,12 +1349,42 @@ class LaneScheduler:
             return False
         return True
 
+    def _lane_anchor(self, lane: int) -> tuple[int | None, int]:
+        """The lane's current radix anchor (node_id, matched tokens) —
+        the shared-store grouping key captured by the admission match —
+        or (None, 0) when sharing is off / nothing matched."""
+        if self.spec_store is not None and self.kv is not None:
+            a = self.kv.anchor_for(lane)
+            if a is not None:
+                return a
+        return (None, 0)
+
+    def _make_drafter(self, lane: int, stream_id: str) -> NgramDrafter:
+        anchor, aoff = self._lane_anchor(lane)
+        return NgramDrafter(
+            k_max=self.spec_k,
+            shared_store=self.spec_store,
+            stream_id=stream_id,
+            anchor=anchor,
+            anchor_offset=aoff,
+            use_draft_model=(
+                self.spec_mode == "draft" and self.engine.has_draft_model
+            ),
+        )
+
     def _spec_drafts(self) -> dict[int, list[int]]:
         """Collect this tick's draft proposals: greedy lanes whose
-        n-gram drafter proposes >=1 token within the lane's remaining
-        budget (both max_tokens and seq_len cap the accepted run)."""
+        drafter proposes >=1 token within the lane's remaining budget
+        (both max_tokens and seq_len cap the accepted run). The source
+        ladder runs per lane — private n-gram vs the shared store's
+        sibling continuations, longest suffix match winning, then
+        (mode draft) one batched draft-model propose over every lane
+        both n-gram sources left dry."""
         out: dict[int, list[int]] = {}
+        st = self.state
         seq_len = self.engine.header.seq_len
+        self._draft_fed.clear()
+        model_lanes: dict[int, int] = {}  # lane -> model-draft budget
         for lane, dr in self.drafters.items():
             ls = self.lanes[lane]
             if ls is None:
@@ -1311,9 +1394,99 @@ class LaneScheduler:
             room = min(ls.max_pos, seq_len) - ls.pos - 1
             if room < 1:
                 continue
-            d = dr.draft(budget=min(self.spec_k, room))
+            budget = min(self.spec_k, room)
+            d = dr.draft(budget=budget)
             if d:
                 out[lane] = d
+                if st.m_spec_source is not None and dr.last_source:
+                    st.m_spec_source.labels(source=dr.last_source).inc(
+                        len(d)
+                    )
+                continue
+            mb = dr.model_budget(budget)
+            if mb > 0:
+                model_lanes[lane] = mb
+        if model_lanes:
+            for lane, d in self._draft_with_model(model_lanes).items():
+                dr = self.drafters.get(lane)
+                if dr is not None:
+                    dr.last_source = SOURCE_DRAFT
+                out[lane] = d
+                if st.m_spec_source is not None:
+                    st.m_spec_source.labels(source=SOURCE_DRAFT).inc(
+                        len(d)
+                    )
+        if self.spec_store is not None and st.g_spec_store_groups is not None:
+            stats = self.spec_store.stats()
+            st.g_spec_store_groups.set(stats["groups"])
+            st.g_spec_store_streams.set(stats["streams"])
+            st.g_spec_store_tokens.set(stats["tokens"])
+            st.g_spec_store_hits.set(stats["hits"])
+            st.g_spec_store_misses.set(stats["misses"])
+        return out
+
+    def _draft_with_model(
+        self, budgets: dict[int, int]
+    ) -> dict[int, list[int]]:
+        """Resident-draft-model proposals for lanes whose n-gram sources
+        ran dry: per lane, catch the draft KV cache up on the verified
+        history it has not seen (bucketed draft_prefill chunks), then
+        ONE batched draft_step dispatch autoregresses k greedy tokens
+        for every such lane. Purely advisory — any failure here skips
+        model drafting for the tick (the lanes fall back to the decode
+        block) and never touches the target cache."""
+        eng = self.engine
+        b = len(self.lanes)
+        dseq = eng.draft_seq_len
+        if eng.draft_cache_epoch != self._draft_epoch:
+            # the draft cache was rebuilt (draft-side dispatch failure):
+            # every lane's draft context is gone; cursors restart at 0
+            # and the catch-up below re-derives them from host history
+            self._draft_pos.clear()
+            self._draft_epoch = eng.draft_cache_epoch
+        k = 0
+        lanes: list[int] = []
+        try:
+            for lane in budgets:
+                ls = self.lanes[lane]
+                if ls is None or ls.pos + budgets[lane] > dseq:
+                    continue
+                dpos = self._draft_pos.get(lane, 0)
+                if dpos < ls.pos:
+                    # feed history[dpos:pos] at dpos: rows past a verify
+                    # rewind are overwritten here before any draft query
+                    # can attend to them (same causal-mask argument as
+                    # the target's rewind)
+                    eng.draft_prefill(lane, ls.history[dpos:ls.pos], dpos)
+                    self._draft_pos[lane] = ls.pos
+                lanes.append(lane)
+                k = max(k, budgets[lane])
+            if not lanes or k < 1:
+                return {}
+            k = bucket_for(k, self.spec_buckets)
+            tokens = [0] * b
+            pos = [0] * b
+            act = [False] * b
+            for lane in lanes:
+                ls = self.lanes[lane]
+                tokens[lane] = ls.token
+                pos[lane] = ls.pos
+                act[lane] = True
+            props = eng.draft_propose(tokens, pos, act, k)
+        except Exception as e:
+            self.state.recorder.record(
+                "draft_model_error", error=str(e),
+                error_type=type(e).__name__, n_lanes=len(budgets),
+            )
+            return {}
+        if not props:
+            return {}
+        out: dict[int, list[int]] = {}
+        for lane in lanes:
+            d = props[lane][: budgets[lane]]
+            if d:
+                out[lane] = d
+                self._draft_fed[lane] = (pos[lane], len(d))
         return out
 
     def _spec_verify(self, drafts: dict[int, list[int]]) -> None:
@@ -1380,6 +1553,13 @@ class LaneScheduler:
             dr = self.drafters.get(lane)
             if dr is not None:
                 dr.feedback(len(d), a)
+            fed = self._draft_fed.pop(lane, None)
+            if fed is not None:
+                # draft-cache rows p+j hold history[p+j] for j <= a (row
+                # p is the pending token, row p+i is draft i-1, valid
+                # iff i-1 accepted drafts agree); rows past the rewind
+                # point are stale and re-fed by catch-up before use
+                self._draft_pos[lane] = fed[0] + min(a + 1, fed[1])
             st.m_spec_drafted.inc(len(d))
             st.m_spec_accepted.inc(a)
             st.m_spec_accept_len.observe(float(a))
@@ -1399,6 +1579,14 @@ class LaneScheduler:
         if st.m_spec_drafted.value > 0:
             st.g_spec_rate.set(
                 st.m_spec_accepted.value / st.m_spec_drafted.value
+            )
+        if (
+            st.g_spec_tokens_per_pass is not None
+            and st.m_spec_accept_len.count > 0
+        ):
+            # each verify dispatch is one weight pass emitting 1+a tokens
+            st.g_spec_tokens_per_pass.set(
+                1.0 + st.m_spec_accept_len.sum / st.m_spec_accept_len.count
             )
 
     def _step_block(self) -> None:
@@ -1699,6 +1887,57 @@ class ApiState:
             "Cumulative accepted/drafted token ratio of the n-gram "
             "speculator (0 until the first verify dispatch).",
         )
+        # second-generation speculation (PR 18): per-source draft volume,
+        # shared-store occupancy, and the roofline-facing tokens-per-
+        # weight-pass gauge. Registered only when speculation is on so
+        # `--speculation off` stays a pure bypass (no new series).
+        self.m_spec_source = None
+        self.g_spec_tokens_per_pass = None
+        self.g_spec_store_groups = None
+        self.g_spec_store_streams = None
+        self.g_spec_store_tokens = None
+        self.g_spec_store_hits = None
+        self.g_spec_store_misses = None
+        if speculation != "off":
+            self.m_spec_source = self.obs.counter(
+                "dllama_spec_source_total",
+                "Draft tokens proposed, by source: the lane's private "
+                "n-gram index, a sibling continuation from the shared "
+                "store, or the resident draft model.",
+                labelnames=("source",),
+            )
+            self.g_spec_tokens_per_pass = self.obs.gauge(
+                "dllama_spec_tokens_per_weight_pass",
+                "Mean tokens emitted per verify dispatch (1 + mean "
+                "accepted prefix length) — compare against the roofline "
+                "ceiling printed at startup.",
+            )
+        if speculation in ("shared", "draft"):
+            self.g_spec_store_groups = self.obs.gauge(
+                "dllama_spec_shared_store_groups",
+                "Anchor groups (radix node identities) currently held "
+                "by the cross-lane shared n-gram store.",
+            )
+            self.g_spec_store_streams = self.obs.gauge(
+                "dllama_spec_shared_store_streams",
+                "Published stream continuations across all anchor "
+                "groups in the shared n-gram store.",
+            )
+            self.g_spec_store_tokens = self.obs.gauge(
+                "dllama_spec_shared_store_tokens",
+                "Accepted tokens retained across all shared-store "
+                "stream continuations.",
+            )
+            self.g_spec_store_hits = self.obs.gauge(
+                "dllama_spec_shared_store_hits",
+                "Cumulative shared-store lookups that returned a "
+                "sibling continuation.",
+            )
+            self.g_spec_store_misses = self.obs.gauge(
+                "dllama_spec_shared_store_misses",
+                "Cumulative shared-store lookups that found no usable "
+                "sibling continuation.",
+            )
         # oversubscription (PR 16): streams beyond the lane count park
         # (publish + drop page list, radix entry kept) and resume via
         # the recovery-admission path
@@ -2781,6 +3020,7 @@ def serve(
     series_retention: float | None = None,
     speculation: str | None = None,
     spec_k: int | None = None,
+    draft_model: str | None = None,
     retry_max: int | None = None,
     retry_backoff_ms: int | None = None,
     max_queue_depth: int | None = None,
@@ -2793,6 +3033,16 @@ def serve(
     )
     streams = resolve_stream_knobs(max_streams)
     spec_mode, spec_k_val = resolve_spec_knobs(speculation, spec_k)
+    if spec_mode == "draft":
+        draft_path = resolve_draft_model(draft_model)
+        if draft_path is None:
+            raise ValueError(
+                "--speculation draft needs a draft checkpoint: pass "
+                "--draft-model or set DLLAMA_DRAFT_MODEL"
+            )
+        # load BEFORE ApiState: the scheduler's admission rehearsal
+        # prefetches draft_prefill/draft_step only if the model is there
+        engine.init_draft_model(draft_path)
     r_max, r_backoff, q_depth = resolve_resilience_knobs(
         retry_max, retry_backoff_ms, max_queue_depth
     )
@@ -2932,6 +3182,7 @@ def main(argv=None) -> None:
                 series_retention=args.series_retention,
                 speculation=args.speculation,
                 spec_k=args.spec_k,
+                draft_model=args.draft_model,
                 retry_max=args.retry_max,
                 retry_backoff_ms=args.retry_backoff_ms,
                 max_queue_depth=args.max_queue_depth,

@@ -400,6 +400,14 @@ class InferenceEngine:
         # concurrent dispatch on the serving thread.
         self._param_specs = jax.tree.map(_sds, self.params)
         self._cache_specs = jax.tree.map(_sds, self.cache)
+        # resident draft model (second-generation speculation): loaded on
+        # demand by init_draft_model; None means mode "draft" is off and
+        # no draft program ever compiles
+        self._draft_params = None
+        self._draft_header: LlmHeader | None = None
+        self.draft_cache = None
+        self.draft_cache_epoch = 0
+        self._m_spec_draft_ms = None
         # shared KV page pool (cross-lane prefix sharing): allocated on
         # demand by init_kv_pool; None means the paged path is off
         self.kv_pool = None
@@ -1165,6 +1173,27 @@ class InferenceEngine:
                             tt, w, origin="prefetch"
                         ),
                     )
+        if spec_k > 0 and self._draft_params is not None:
+            # resident draft model: its catch-up prefill buckets and
+            # k-step propose blocks sit on the serving path exactly like
+            # the verify programs — pre-build them all (they are tiny)
+            from .spec import spec_buckets as _sb
+
+            dseq = self._draft_header.seq_len
+            for bucket in self.prefill_buckets:
+                if bucket > dseq:
+                    continue
+                self._prefetch(
+                    ("draft_prefill", bucket),
+                    lambda b=bucket: self._draft_prefill_fn(
+                        b, origin="prefetch"
+                    ),
+                )
+            for kb in _sb(min(spec_k, self._lane_pad - 1)):
+                self._prefetch(
+                    ("draft_step", kb),
+                    lambda n=kb: self._draft_step_fn(n, origin="prefetch"),
+                )
         if self.kv_pool is not None and native:
             # the only device copy left on the native path: the COW fork
             # of a mid-page adoption boundary (one page at a time)
@@ -2487,6 +2516,388 @@ class InferenceEngine:
             ms=round(dt * 1000, 3),
         )
         return [[int(x) for x in row] for row in out_np]
+
+    # -- resident draft model (second-generation speculation) ----------------
+
+    @property
+    def has_draft_model(self) -> bool:
+        return self._draft_params is not None
+
+    @property
+    def draft_seq_len(self) -> int:
+        """The draft checkpoint's own context length — the scheduler must
+        not request model drafts for a lane past this position (the tiny
+        checkpoint may carry a shorter seqLen than the target)."""
+        return self._draft_header.seq_len if self._draft_header else 0
+
+    def init_draft_model(self, model_path: str) -> None:
+        """Load a tiny Llama-family DRAFT checkpoint into the same engine
+        (``--speculation draft``, runtime/spec.py): its params live
+        beside the target's on the same mesh, its KV cache mirrors the
+        lane layout (own seqLen + the same padding rows), and its
+        programs (``draft_prefill`` chunk buckets, ``draft_step`` greedy
+        k-step blocks) go through the SAME _compile_lock/_inflight/
+        rehearse machinery as every serving program — AOT-compiled,
+        xlalint-checked, cost-budgeted. The draft must share the
+        target's tokenizer, which structurally means its vocab: drafts
+        are proposed as target token ids and verified by the target, so
+        a vocab mismatch is a config error, not a quality problem."""
+        self._require_lanes()
+        if self.pp > 1 or self.sp > 1:
+            raise ValueError(
+                "draft model requires pp == 1 and sp == 1 (the draft "
+                "forward runs on the flat mesh path)"
+            )
+        reader = ModelReader(model_path, max_seq_len=self.header.seq_len)
+        dh = reader.header
+        if dh.vocab_size != self.header.vocab_size:
+            raise ValueError(
+                f"draft model vocab {dh.vocab_size} != target vocab "
+                f"{self.header.vocab_size}; the draft must share the "
+                "target's tokenizer"
+            )
+        validate_tp(dh, self.tp)
+        # dense weights: the draft is tiny, so the q40 device formats'
+        # divisibility constraints and kernel launches buy nothing here
+        self._draft_params = load_params(
+            reader,
+            dtype=self.dtype,
+            put=shard_params_put(self.mesh, dh),
+            weight_format="dense",
+            fuse=0,
+        )
+        self._draft_header = dh
+        self._draft_cache_sharding = {
+            k: NamedSharding(self.mesh, spec)
+            for k, spec in cache_specs(dh, sp=False, pp=False).items()
+        }
+        mesh = self.mesh
+
+        def dfwd(params, tokens, pos, cache, *, attn_park_threshold=0,
+                 logits_mode="all"):
+            return forward(
+                params, dh, tokens, pos, cache, mesh=mesh,
+                attn_window=0, logits_mode=logits_mode,
+                attn_park_threshold=attn_park_threshold,
+            )
+
+        self._draft_fwd = dfwd
+        self.draft_cache = self._fresh_draft_cache()
+        self._draft_param_specs = jax.tree.map(_sds, self._draft_params)
+        self._draft_cache_specs = jax.tree.map(_sds, self.draft_cache)
+        self._m_spec_draft_ms = self.obs.histogram(
+            "dllama_spec_draft_model_step_ms",
+            "Wall milliseconds of one draft-model dispatch (catch-up "
+            "prefill chunk or k-step propose block).",
+            labelnames=("kind",),
+            buckets=(0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500, 1000),
+        )
+        self.recorder.record(
+            "draft_model_loaded", path=model_path, seq_len=dh.seq_len,
+            vocab=dh.vocab_size,
+        )
+
+    def _require_draft_model(self) -> None:
+        if self._draft_params is None:
+            raise ValueError("draft model not loaded (init_draft_model)")
+
+    def _fresh_draft_cache(self):
+        """Rebuild the draft KV cache; bumps draft_cache_epoch so the
+        scheduler knows every lane's draft context is gone (its
+        _draft_pos map resets and catch-up prefill re-derives it —
+        advisory state only: drafts are always verified by the target,
+        so a dropped draft cache costs acceptance, never bytes)."""
+        self.draft_cache_epoch += 1
+        self.recorder.record(
+            "draft_cache_epoch", epoch=self.draft_cache_epoch
+        )
+        dh = self._draft_header
+        cache = init_kv_cache(
+            dh,
+            self.batch_size,
+            dtype=self.dtype,
+            seq_len=dh.seq_len + self._lane_pad,
+        )
+        return {
+            k: jax.device_put(v, self._draft_cache_sharding[k])
+            for k, v in cache.items()
+        }
+
+    @contextlib.contextmanager
+    def _draft_cache_guard(self):
+        """_cache_guard's draft twin: draft programs donate
+        ``self.draft_cache``, so a failed dispatch rebuilds it before
+        re-raising. The target cache is untouched — a draft-side crash
+        never costs a live conversation its context."""
+        try:
+            yield
+        except BaseException as e:
+            self.recorder.record(
+                "error", error=str(e), error_type=type(e).__name__,
+                draft=True,
+            )
+            try:
+                self.draft_cache = self._fresh_draft_cache()
+            except Exception as rebuild_err:  # pragma: no cover
+                raise rebuild_err from e
+            raise
+
+    def _draft_park(self) -> int:
+        return self._draft_header.seq_len  # first draft padding row
+
+    def _draft_bucket_for(self, n: int, pos: int) -> int:
+        """_bucket_for against the DRAFT sequence length (the draft
+        checkpoint may be shorter than the target)."""
+        space = self._draft_header.seq_len - pos
+        fitting = [b for b in self.prefill_buckets if b <= space]
+        if not fitting:
+            return max(min(space, n), 1)
+        for b in fitting:
+            if n <= b:
+                return b
+        return fitting[-1]
+
+    def _draft_prefill_arg_specs(self, t: int):
+        b = self.batch_size
+        tok = jax.ShapeDtypeStruct(
+            (b, t), jnp.int32, sharding=self._token_sharding
+        )
+        return (
+            self._draft_param_specs,
+            tok,
+            self._draft_cache_specs,
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+        )
+
+    def _draft_prefill_fn(self, t: int, origin: str = "dispatch"):
+        """Draft-cache catch-up prefill: one lane writes a chunk at its
+        own position, every other lane parks in the draft padding rows —
+        _lane_prefill_fn against the draft params/cache. Full attention
+        reads (window 0): the draft is small enough that windowing buys
+        nothing over its whole seqLen."""
+        key = ("draft_prefill", t)
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
+            ev = self._inflight.get(key) if origin == "dispatch" else None
+        if ev is not None:
+            ev.wait()
+            with self._compile_lock:
+                if key in self._compiled:
+                    return self._compiled[key]
+        self._require_draft_model()
+        dfwd = self._draft_fwd
+        park = self._draft_park()
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def step(params, tokens, cache, pos_vec):
+            _, cache = dfwd(
+                params, tokens, pos_vec, cache,
+                attn_park_threshold=park, logits_mode="last",
+            )
+            return cache
+
+        self.recorder.record("compile_start", key=str(key), origin=origin)
+        t0 = time.perf_counter()
+        if self._aot_blocks:
+            step = step.lower(*self._draft_prefill_arg_specs(t)).compile()
+        dt = time.perf_counter() - t0
+        with self._compile_lock:
+            self._compiled[key] = step
+            self._compile_origin[key] = origin
+            if self._aot_blocks:
+                self._compile_seconds[key] = dt
+        self._m_compiles.labels(origin=origin).inc()
+        self.recorder.record(
+            "compile_end", key=str(key), origin=origin, s=round(dt, 4)
+        )
+        self._xlalint_after_compile(key)
+        return step
+
+    def _draft_step_arg_specs(self, n_steps: int):
+        b = self.batch_size
+        tok = jax.ShapeDtypeStruct(
+            (b, 1), jnp.int32, sharding=self._token_sharding
+        )
+        return (
+            self._draft_param_specs,
+            tok,
+            self._draft_cache_specs,
+            jax.ShapeDtypeStruct((b,), jnp.int32),
+            jax.ShapeDtypeStruct((b,), jnp.bool_),
+        )
+
+    def _draft_step_fn(self, n_steps: int, origin: str = "dispatch"):
+        """Greedy k-step draft-model block: _lane_decode_fn's shape
+        (per-lane positions, parked inactive lanes, fori_loop feed-back)
+        minus sampling — drafts only ever seed a greedy verify, so plain
+        argmax is the whole sampler. One host dispatch proposes k tokens
+        for every drafting lane at once."""
+        key = ("draft_step", n_steps)
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
+            ev = self._inflight.get(key) if origin == "dispatch" else None
+        if ev is not None:
+            ev.wait()
+            with self._compile_lock:
+                if key in self._compiled:
+                    return self._compiled[key]
+        self._require_draft_model()
+        dfwd = self._draft_fwd
+        park = self._draft_park()
+        dseq = self._draft_header.seq_len
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def block(params, token, cache, pos_vec, active):
+            def body(i, carry):
+                tok, cache, out = carry
+                ok = jnp.logical_and(active, pos_vec + i < dseq)
+                cur = jnp.where(ok, pos_vec + i, park)
+                logits, cache = dfwd(
+                    params, tok, cur, cache,
+                    attn_park_threshold=park, logits_mode="last",
+                )
+                nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+                nxt = jnp.where(ok, nxt, 0).reshape(-1, 1)
+                out = lax.dynamic_update_index_in_dim(
+                    out, nxt[:, 0], i, axis=0
+                )
+                return nxt, cache, out
+
+            out0 = jnp.zeros((n_steps, token.shape[0]), jnp.int32)
+            _, cache, out = lax.fori_loop(
+                0, n_steps, body, (token, cache, out0)
+            )
+            return out, cache
+
+        self.recorder.record("compile_start", key=str(key), origin=origin)
+        t0 = time.perf_counter()
+        if self._aot_blocks:
+            block = block.lower(*self._draft_step_arg_specs(n_steps)).compile()
+        dt = time.perf_counter() - t0
+        with self._compile_lock:
+            self._compiled[key] = block
+            self._compile_origin[key] = origin
+            if self._aot_blocks:
+                self._compile_seconds[key] = dt
+        self._m_compiles.labels(origin=origin).inc()
+        self.recorder.record(
+            "compile_end", key=str(key), origin=origin, s=round(dt, 4)
+        )
+        self._xlalint_after_compile(key)
+        return block
+
+    def draft_prefill(self, lane: int, tokens: list[int], pos0: int) -> None:
+        """Catch the draft cache up on `lane`: write `tokens` (context
+        rows the draft has not seen — typically the tail the target
+        accepted since the last model draft) at pos0.., chunked through
+        the bucketed draft_prefill programs. Rows past a later rewind
+        are overwritten by the next catch-up before any draft query can
+        attend to them — the same causal-mask argument that makes the
+        target's verify rewind safe."""
+        self._require_draft_model()
+        if not 0 <= lane < self.batch_size:
+            raise ValueError(f"lane {lane} out of range")
+        n = len(tokens)
+        if n < 1:
+            return
+        dseq = self._draft_header.seq_len
+        if pos0 + n > dseq:
+            raise ValueError(
+                f"{n} draft fill tokens at pos {pos0} exceed draft "
+                f"seqLen {dseq}"
+            )
+        park = self._draft_park()
+        fills = list(tokens)
+        p = pos0
+        t0 = time.perf_counter()
+        while fills:
+            bucket = self._draft_bucket_for(len(fills), p)
+            width = min(bucket, len(fills))
+            chunk = fills[:width] + [0] * (bucket - width)
+            rows = [[0] * bucket for _ in range(self.batch_size)]
+            rows[lane] = chunk
+            posv = [park] * self.batch_size
+            posv[lane] = p
+            step = self._draft_prefill_fn(bucket)
+            arr = jax.device_put(
+                jnp.asarray(rows, jnp.int32), self._token_sharding
+            )
+            with self._draft_cache_guard():
+                self.draft_cache = step(
+                    self._draft_params, arr, self.draft_cache,
+                    jnp.asarray(posv, jnp.int32),
+                )
+            fills = fills[width:]
+            p += width
+        dt = time.perf_counter() - t0
+        self._m_step.labels(kind="draft_prefill").observe(dt)
+        if self._m_spec_draft_ms is not None:
+            self._m_spec_draft_ms.labels(kind="prefill").observe(dt * 1000)
+        self.recorder.record(
+            "step_complete", step="draft_prefill", lane=lane, pos=pos0,
+            n_tokens=n, ms=round(dt * 1000, 3),
+        )
+
+    def draft_propose(
+        self,
+        tokens: list[int],
+        pos: list[int],
+        active: list[bool],
+        k: int,
+    ) -> list[list[int]]:
+        """Propose up to `k` greedy draft-model tokens per ACTIVE lane in
+        one dispatch: lane l feeds tokens[l] at pos[l] and autoregresses
+        k steps through the draft. Returns [lanes][k] (inactive or
+        past-draft-capacity rows report 0). Purely advisory — every
+        returned token goes through the target's verify pass, so this
+        can be wrong, stale, or truncated without any correctness
+        cost."""
+        self._require_draft_model()
+        if len(tokens) != self.batch_size or len(pos) != self.batch_size:
+            raise ValueError("tokens/pos must have one entry per lane")
+        live = [i for i, a in enumerate(active) if a]
+        if not live or k < 1:
+            return []
+        dseq = self._draft_header.seq_len
+        k = min(k, max(dseq - pos[i] for i in live))
+        if k <= 0:
+            return []
+        block = self._draft_step_fn(k)
+        arr = jax.device_put(
+            jnp.asarray([[t] for t in tokens], jnp.int32),
+            self._token_sharding,
+        )
+        self.recorder.record(
+            "step_dispatch", step="draft_step", n_steps=k,
+            n_live=len(live),
+        )
+        sp = self._spans.begin(
+            "draft_step", component="engine", n_steps=k, n_live=len(live),
+        )
+        t0 = time.perf_counter()
+        with self._draft_cache_guard():
+            out, self.draft_cache = block(
+                self._draft_params, arr, self.draft_cache,
+                jnp.asarray(pos, jnp.int32),
+                jnp.asarray(active, jnp.bool_),
+            )
+            out_np = np.asarray(out)
+        dt = time.perf_counter() - t0
+        self._spans.end(sp)
+        self._m_step.labels(kind="draft_step").observe(dt)
+        if self._m_spec_draft_ms is not None:
+            self._m_spec_draft_ms.labels(kind="propose").observe(dt * 1000)
+        self.recorder.record(
+            "step_complete", step="draft_step", n_steps=k,
+            n_live=len(live), ms=round(dt * 1000, 3),
+        )
+        # transpose [k][lanes] -> [lanes][k]
+        return [
+            [int(out_np[i][lane]) for i in range(k)]
+            for lane in range(self.batch_size)
+        ]
 
     def _bucket_for(self, n: int, pos: int) -> int:
         """Smallest bucket covering n tokens whose PADDED extent still fits

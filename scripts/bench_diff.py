@@ -54,6 +54,10 @@ WATCHED: dict[str, str] = {
     # a drop here means affinity routing stopped landing prompts on the
     # replica that already holds their prefix (ISSUE 17)
     "SERVING.fleet.goodput_tok_s": "higher",
+    # cross-lane shared speculation on the natural-language fanout
+    # round: a drop means sibling continuations stopped reaching the
+    # drafter through the shared n-gram store (ISSUE 18)
+    "SERVING.speculation_nl.tok_s_shared": "higher",
 }
 
 
