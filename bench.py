@@ -1249,11 +1249,11 @@ def _serving_smoke(n_clients: int) -> dict:
     fleet_affinity = fleet_round(fleet_port, "aff")
     rand_srv.shutdown()
 
-    # seeded kill round: 4 greedy streams while the fault plane drops two
-    # of them mid-flush on r0 — the router must resume each on r1 and the
-    # client side must still read a finish_reason (completion rate 1.0;
-    # byte-identity is asserted in tests/test_fleet.py where the baseline
-    # bytes are captured)
+    # seeded kill round: 4 greedy streams while the fault plane drops one
+    # stream mid-flush on each replica — the router must resume each dead
+    # stream on the sibling and the client side must still read a
+    # finish_reason (completion rate 1.0; byte-identity is asserted in
+    # tests/test_fleet.py where the baseline bytes are captured)
     kill_done = [False] * 4
 
     def kill_stream(i: int) -> None:
@@ -1274,13 +1274,16 @@ def _serving_smoke(n_clients: int) -> dict:
         )
 
     fr_state = fleet_h.router.state
-    victim = fr_state.route(
-        fr_state.prompt_tokens(
-            [{"role": "user", "content": "kill round 0"}]
-        )
-    ).target
+    # arm ONE fleet-wide one-shot kill (2nd SSE flush, any replica)
+    # rather than pre-computing a victim: the router's capacity-aware
+    # spill can steer a burst away from any one replica between arming
+    # and streaming, and arming both replicas separately lets a single
+    # unlucky stream eat both faults (die, fail over, die again) and
+    # exhaust its two candidates. One op-less schedule counts draws
+    # across the whole fleet, so exactly one stream dies wherever it
+    # landed and its sibling is guaranteed clean for the catch-up.
     pre_kill = scrape_port(fleet_port)
-    set_fault_plane(f"sse_flush:op={victim}:nth=2:n=2")
+    set_fault_plane("sse_flush:nth=2:n=1")
     kill_threads = [
         threading.Thread(
             target=kill_stream, args=(i,), daemon=True,
@@ -1294,6 +1297,55 @@ def _serving_smoke(n_clients: int) -> dict:
         t.join()
     set_fault_plane("")
     post_kill = scrape_port(fleet_port)
+
+    # fleet observability plane (ISSUE 19): the kill round left a
+    # stitched story behind — pull the failed-over request's merged
+    # Perfetto timeline through the router, plus the fleet aggregates
+    # and the anomaly monitor's verdict. A healthy run must report the
+    # monitor calm (anomaly_degraded False); failover_gap_ms_p99 is the
+    # cost of a mid-stream hand-off as the client saw it.
+    def fleet_json(path_: str) -> dict:
+        conn = http.client.HTTPConnection("127.0.0.1", fleet_port, timeout=60)
+        conn.request("GET", path_)
+        r = conn.getresponse()
+        body = json.loads(r.read().decode("utf-8"))
+        conn.close()
+        return body
+
+    scrape_ok = fr_state.fleet.scrape_once()
+    stitched: dict = {}
+    recent = fleet_json("/v1/fleet/timeline").get("recent", [])
+    hop = next((e for e in recent if e.get("n_failovers")), None)
+    if hop is not None:
+        merged = fleet_json(
+            f"/v1/fleet/timeline?request_id={hop['request_id']}"
+        )
+        info = merged.get("dllama", {})
+        sources = info.get("sources", {})
+        stitched = {
+            "replicas": info.get("replicas", []),
+            "n_spans": info.get("n_spans", 0),
+            "router_spans": sources.get("router", 0),
+            "replica_spans": sum(
+                n for k, n in sources.items() if k != "router"
+            ),
+            "fetch_errors": len(info.get("fetch_errors", [])),
+        }
+    monitor = fr_state.fleet.monitor.status()
+    gap_p99 = fr_state.m_gap.percentile(0.99)
+    fleet_obs = {
+        "scrape_ok": all(scrape_ok.values()) and len(scrape_ok) == 2,
+        "fleet_goodput_series": (
+            "dllama_fleet_goodput_tokens_per_s" in fr_state.fleet.store.names()
+        ),
+        "anomaly_degraded": bool(monitor["degraded"]),
+        "active_signals": sorted(monitor.get("active", {})),
+        "failover_gap_ms_p99": (
+            round(gap_p99 * 1000, 2) if gap_p99 is not None else None
+        ),
+        "stitched": stitched,
+    }
+
     fleet_block = {
         "n_replicas": 2,
         "n_requests": fleet_n,
@@ -1309,6 +1361,7 @@ def _serving_smoke(n_clients: int) -> dict:
                 - metric_value(pre_kill, "dllama_router_failovers_total")
             ),
         },
+        "fleet_obs": fleet_obs,
     }
     fleet_h.close()
 

@@ -48,6 +48,14 @@ class FleetHandle:
             for name, srv in self.replicas
         }
 
+    @property
+    def router_state(self):
+        """The router's :class:`~dllama_tpu.fleet.router.RouterState` —
+        tests and the bench reach the fleet observability plane here
+        (``.fleet`` for scrape/sampler/monitor, ``.spans`` for router
+        spans, ``.ledger`` for request history)."""
+        return self.router.state
+
     def close(self) -> None:
         self.router.shutdown()
         self.router.server_close()  # stops the health poller too
@@ -210,7 +218,9 @@ def main(argv=None) -> None:
         )
         print(
             f"Fleet router: http://{args.host}:{args.port}/v1/ "
-            f"({len(replicas)} replicas)"
+            f"({len(replicas)} replicas)\n"
+            f"Fleet dashboard: http://{args.host}:{args.port}/dashboard "
+            f"· metrics: /metrics · timelines: /v1/fleet/timeline"
         )
         try:
             server.serve_forever()

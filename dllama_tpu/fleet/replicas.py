@@ -132,6 +132,11 @@ class ReplicaRegistry:
     def url_of(self, name: str) -> str:
         return self._replicas[name].base_url
 
+    def urls(self) -> dict[str, str]:
+        """Name -> base URL for every registered replica (the fleet
+        scraper and recorder/timeline fan-outs iterate this)."""
+        return {name: rep.base_url for name, rep in self._replicas.items()}
+
     def _transition(self, rep: Replica, state: str, reason: str) -> None:
         """Caller holds the lock."""
         if rep.state == state:

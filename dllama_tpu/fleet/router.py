@@ -29,9 +29,26 @@ fronts N replicas:
   contract and its two edge cases (stop strings and incomplete UTF-8
   spanning the boundary).
 
+* **Fleet observability (ISSUE 19).** The router mints a trace id +
+  request id per client request and forwards them as
+  ``x-dllama-trace``/``x-dllama-request`` headers on every relay
+  INCLUDING failover re-issues, so every replica that touched a request
+  records the same fleet-level identity. The router keeps its OWN
+  :class:`~dllama_tpu.obs.spans.SpanTracker` (tokenize, route_plan,
+  relay, stall_detect, failover, catch_up_synthesis spans);
+  ``GET /v1/fleet/timeline?request_id=`` stitches the router fragment
+  with per-replica ``/v1/debug/timeline`` fragments into one Perfetto
+  trace where a mid-stream failover renders as a single continuous
+  request with the gap attributed to an explicit ``failover`` span.
+  ``GET /metrics`` re-exports replica metrics with a ``replica`` label
+  and the fleet aggregates (:mod:`.obs`); the fleet anomaly rules feed
+  ``/v1/health`` ``degraded_reasons``; ``/dashboard`` overlays
+  per-replica sparklines.
+
 Knobs resolve CLI-beats-env-beats-default via the ``DLLAMA_FLEET_*``
 family: ``DLLAMA_FLEET_AFFINITY_K``, ``DLLAMA_FLEET_FAILOVER_MAX``,
-``DLLAMA_FLEET_STALL_S``, ``DLLAMA_FLEET_POLL_S``.
+``DLLAMA_FLEET_STALL_S``, ``DLLAMA_FLEET_POLL_S``; the observability
+plane adds the ``DLLAMA_FLEET_OBS_*`` family (:mod:`.obs`).
 """
 
 from __future__ import annotations
@@ -43,11 +60,14 @@ import random
 import threading
 import time
 import urllib.request
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlsplit
+from urllib.parse import parse_qs, urlencode, urlsplit
 
+from ..obs.dashboard import DASHBOARD_CONTENT_TYPE, render_dashboard
 from ..obs.metrics import get_registry
 from ..obs.recorder import get_recorder
+from ..obs.spans import SpanTracker
 from ..tokenizer import (
     CHAT_TEMPLATE_NAMES,
     ChatItem,
@@ -61,6 +81,13 @@ from .affinity import (
     RoutePlan,
     plan_route,
     prefix_affinity_key,
+)
+from .obs import (
+    PID_STRIDE,
+    FleetObs,
+    RequestLedger,
+    resolve_fleet_obs_knobs,
+    stitch_timelines,
 )
 from .replicas import ReplicaRegistry
 
@@ -133,6 +160,7 @@ class RouterState:
         stall_timeout_s: float | None = None,
         routing: str = "affinity",
         seed: int = 0,
+        fleet_obs: FleetObs | None = None,
     ):
         if routing not in ("affinity", "random"):
             raise ValueError(f"unknown routing mode {routing!r}")
@@ -191,6 +219,37 @@ class RouterState:
             "(dead, draining, saturated, degraded, shed, refused).",
             labelnames=("reason",),
         )
+        self.m_stalls = self.obs.counter(
+            "dllama_router_stalls_total",
+            "Mid-stream failovers triggered specifically by a read stall "
+            "past the watchdog timeout (subset of failovers_total).",
+        )
+        self.m_gap = self.obs.histogram(
+            "dllama_router_failover_gap_seconds",
+            "Client-visible failover gap: replica stream death to the "
+            "catch-up delta landing from the sibling (the recovery "
+            "latency the fleet bench watches at p99).",
+        )
+        # the router's OWN span tracker — deliberately NOT the process
+        # global: in the in-process fleet the global tracker belongs to
+        # the replicas, and the stitcher must be able to fetch router
+        # and replica fragments as disjoint span sets
+        self.spans = SpanTracker()
+        _, _, ledger_cap = resolve_fleet_obs_knobs()
+        self.ledger = RequestLedger(ledger_cap)
+        # scrape/aggregate/anomaly plane; injectable so the fake-clock
+        # anomaly test drives a FleetObs with a fake fetch + fake clock
+        self.fleet = (
+            fleet_obs
+            if fleet_obs is not None
+            else FleetObs(
+                registry,
+                registry=self.obs,
+                recorder=self.recorder,
+                affinity_rate_fn=self.affinity_rate,
+            )
+        )
+        self.fleet.register()
 
     # --------------------------------------------------------------- route
 
@@ -223,7 +282,53 @@ class RouterState:
             reason = plan.spill_reason
             if reason is not None:
                 self.m_spills.labels(reason=reason).inc()
+                self.recorder.record(
+                    "router_spill",
+                    reason=reason,
+                    target=plan.target,
+                    candidates=list(plan.candidates),
+                )
         return plan
+
+    # ------------------------------------------------------------- fleet
+
+    def affinity_rate(self) -> float | None:
+        """Cumulative affinity hit rate over all routed requests (None
+        before the first request); sampled into
+        ``dllama_fleet_affinity_hit_rate`` each scrape."""
+        total = sum(self.m_requests.child_values().values())
+        if total <= 0:
+            return None
+        return self.m_affinity_hits.value / total
+
+    def health_payload(self) -> dict:
+        """The router's ``/v1/health`` body. Status composes replica
+        registry states with the FLEET anomaly monitor: a fleet rule
+        firing (TPOT skew, failover spike, goodput drop) degrades the
+        router even while every replica individually reports healthy —
+        exactly the fleet-level sickness a per-replica view can't see."""
+        views = self.registry.views()
+        states = [v.state for v in views.values()]
+        if any(s == "healthy" for s in states):
+            status = "ok"
+        elif any(s != "dead" for s in states):
+            status = "degraded"
+        else:
+            status = "unavailable"
+        reasons = [
+            f"fleet_anomaly:{sig}"
+            for sig in self.fleet.monitor.active_signals()
+        ]
+        if reasons and status == "ok":
+            status = "degraded"
+        return {
+            "status": status,
+            "role": "router",
+            "routing": self.routing,
+            "uptime_s": round(time.time() - self.start_unix, 3),
+            "replicas": {name: v.state for name, v in views.items()},
+            "degraded_reasons": reasons,
+        }
 
 
 def make_router_handler(state: RouterState):
@@ -252,19 +357,41 @@ def make_router_handler(state: RouterState):
         # ------------------------------------------------------------ GET
 
         def do_GET(self):
-            path = self.path.partition("?")[0]
+            path, _, query = self.path.partition("?")
+            params = parse_qs(query)
             if path == "/metrics":
+                # run_refresh_hooks triggers the fleet scrape (a keyed
+                # hook, obs.py), so the render below already holds fresh
+                # aggregates; the replica-labelled re-export block is
+                # appended after the router's own families
                 state.obs.run_refresh_hooks()
-                body = state.obs.render().encode("utf-8")
+                text = state.obs.render()
+                fleet = state.fleet.render_fleet()
+                if fleet:
+                    text = text.rstrip("\n") + "\n" + fleet + "\n"
+                body = text.encode("utf-8")
                 self.send_response(200)
                 self.send_header("Content-Type", state.obs.CONTENT_TYPE)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
             elif path == "/v1/health":
-                self._json(self._fleet_health())
+                self._json(state.health_payload())
             elif path == "/v1/fleet":
                 self._json(self._fleet_payload())
+            elif path == "/v1/fleet/timeline":
+                self._fleet_timeline(params)
+            elif path == "/v1/fleet/debug/recorder":
+                self._fleet_recorder()
+            elif path == "/v1/debug/series":
+                self._fleet_series(params)
+            elif path == "/dashboard":
+                body = render_dashboard()
+                self.send_response(200)
+                self.send_header("Content-Type", DASHBOARD_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif path == "/v1/models":
                 self._json(
                     {
@@ -284,22 +411,128 @@ def make_router_handler(state: RouterState):
             else:
                 self.send_error(404, "Not Found")
 
-        def _fleet_health(self) -> dict:
-            views = state.registry.views()
-            states = [v.state for v in views.values()]
-            if any(s == "healthy" for s in states):
-                status = "ok"
-            elif any(s != "dead" for s in states):
-                status = "degraded"
-            else:
-                status = "unavailable"
-            return {
-                "status": status,
-                "role": "router",
-                "routing": state.routing,
-                "uptime_s": round(time.time() - state.start_unix, 3),
-                "replicas": {name: v.state for name, v in views.items()},
-            }
+        def _fetch_json(self, url: str) -> dict:
+            """GET a replica debug endpoint as JSON (raises OSError /
+            ValueError, handled per call site — a dead replica degrades
+            the merged view, never the whole response)."""
+            with urllib.request.urlopen(url, timeout=10.0) as r:
+                return json.loads(r.read())
+
+        def _fleet_timeline(self, params: dict) -> None:
+            """GET /v1/fleet/timeline[?request_id=] — bare: the request
+            ledger's recent entries (pick a request id to stitch); with
+            an id: ONE merged Chrome/Perfetto trace of the router's own
+            spans plus every touched replica's fragment, pid-namespaced
+            per source and rebased onto the router's epoch, so a
+            failover reads as one continuous request with the gap
+            attributed to the router's ``failover`` span."""
+            rid = (params.get("request_id") or [None])[0]
+            if rid is None:
+                self._json({"recent": state.ledger.recent()})
+                return
+            entry = state.ledger.get(rid)
+            if entry is None:
+                self._json(
+                    {
+                        "error": {
+                            "message": f"unknown request_id {rid!r} "
+                            "(the ledger keeps the most recent requests)",
+                        }
+                    },
+                    404,
+                )
+                return
+            router_frag = state.spans.chrome_trace(
+                request_id=rid, pid_prefix="router"
+            )
+            names = sorted(state.registry.names)
+            fragments: list[tuple[str, dict]] = []
+            errors: dict[str, str] = {}
+            for name in entry["replicas"]:
+                # stable pid namespace per replica regardless of which
+                # replicas THIS request touched
+                idx = names.index(name) if name in names else len(names)
+                q = urlencode(
+                    {
+                        "request_id": rid,
+                        "replica": name,
+                        "pid_prefix": name,
+                        "pid_base": PID_STRIDE * (idx + 1),
+                    }
+                )
+                url = state.registry.url_of(name)
+                try:
+                    frag = self._fetch_json(
+                        f"{url}/v1/debug/timeline?{q}"
+                    )
+                except (OSError, ValueError) as e:
+                    errors[name] = f"{type(e).__name__}: {e}"
+                    state.recorder.record(
+                        "fleet_timeline_error", replica=name,
+                        error=errors[name],
+                    )
+                    continue
+                fragments.append((name, frag))
+            merged = stitch_timelines(router_frag, fragments)
+            merged["dllama"]["request_id"] = rid
+            merged["dllama"]["trace_id"] = entry["trace_id"]
+            merged["dllama"]["replicas"] = entry["replicas"]
+            merged["dllama"]["failovers"] = entry["failovers"]
+            if errors:
+                merged["dllama"]["fetch_errors"] = errors
+            self._json(merged)
+
+        def _fleet_recorder(self) -> None:
+            """GET /v1/fleet/debug/recorder — the fleet postmortem in one
+            fetch: the router's flight-recorder ring (router_failover /
+            router_stall / router_spill / drain / scrape events) plus
+            every replica's ring (or the fetch error in its place)."""
+            out: dict = {"router": state.recorder.dump(), "replicas": {}}
+            for name in sorted(state.registry.names):
+                url = state.registry.url_of(name)
+                try:
+                    out["replicas"][name] = self._fetch_json(
+                        f"{url}/v1/debug/recorder"
+                    )
+                except (OSError, ValueError) as e:
+                    out["replicas"][name] = {
+                        "error": f"{type(e).__name__}: {e}"
+                    }
+                    state.recorder.record(
+                        "fleet_recorder_error", replica=name,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+            self._json(out)
+
+        def _fleet_series(self, params: dict) -> None:
+            """GET /v1/debug/series on the router — same shape as the
+            replica endpoint (the shared /dashboard JS reads either) but
+            backed by the FLEET store: aggregate goodput, per-replica
+            TPOT p50, skew, failover counters."""
+            store = state.fleet.store
+            name = (params.get("name") or [None])[0]
+            if name is None:
+                self._json(
+                    {
+                        "names": store.names(),
+                        "interval_s": store.interval_s,
+                        "retention_s": store.retention_s,
+                        "anomaly": state.fleet.monitor.status(),
+                    }
+                )
+                return
+            try:
+                window = float((params.get("window") or ["300"])[0])
+            except ValueError:
+                self._json({"error": {"message": "bad window"}}, 400)
+                return
+            result = store.query(name, window)
+            if result is None:
+                self._json(
+                    {"error": {"message": f"no series {name!r}"}}, 404
+                )
+                return
+            self._json(result)
 
         def _fleet_payload(self) -> dict:
             views = state.registry.views()
@@ -336,20 +569,39 @@ def make_router_handler(state: RouterState):
             if path != "/v1/chat/completions":
                 self.send_error(404, "Not Found")
                 return
+            # fleet identity (ISSUE 19): minted HERE, forwarded on every
+            # relay and failover re-issue, echoed back to the client —
+            # the one id that stitches router spans, replica spans,
+            # recorder events and trace JSONL into a single story
+            rid = f"req-{uuid.uuid4().hex[:12]}"
+            trace = f"trace-{uuid.uuid4().hex[:12]}"
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 messages = body.get("messages")
                 if not isinstance(messages, list) or not messages:
                     raise ValueError("messages required")
-                tokens = state.prompt_tokens(messages)
+                with state.spans.span(
+                    "tokenize", component="router", request_id=rid
+                ):
+                    tokens = state.prompt_tokens(messages)
             except (ValueError, KeyError, TypeError) as e:
                 state.m_requests.labels(
                     replica="none", outcome="bad_request"
                 ).inc()
                 self._json({"error": {"message": f"bad request: {e}"}}, 400)
                 return
-            plan = state.route(tokens)
+            with state.spans.span(
+                "route_plan", component="router", request_id=rid,
+                n_prompt_tokens=len(tokens),
+            ) as route_h:
+                plan = state.route(tokens)
+                state.spans.end(
+                    route_h,
+                    target=plan.target,
+                    candidates=list(plan.candidates),
+                )
+            state.ledger.open(rid, trace)
             if not plan.candidates:
                 state.m_requests.labels(
                     replica="none", outcome="unavailable"
@@ -367,9 +619,9 @@ def make_router_handler(state: RouterState):
                 )
                 return
             if body.get("stream"):
-                self._relay_stream(body, tokens, plan)
+                self._relay_stream(body, tokens, plan, rid, trace)
             else:
-                self._relay_unary(body, plan)
+                self._relay_unary(body, plan, rid, trace)
 
         def _drain(self, params: dict) -> None:
             """POST /v1/drain?replica=NAME — forward the drain and stop
@@ -410,13 +662,20 @@ def make_router_handler(state: RouterState):
 
         # --------------------------------------------------- unary relay
 
-        def _relay_unary(self, body: dict, plan: RoutePlan) -> None:
+        def _relay_unary(
+            self, body: dict, plan: RoutePlan, rid: str, trace: str
+        ) -> None:
             """Non-stream requests: whole-request retry on the next
             candidate (greedy/seeded requests reproduce; an unseeded
             sampled request re-samples — documented in docs/fleet.md)."""
+            headers = {"x-dllama-trace": trace, "x-dllama-request": rid}
             for name in plan.candidates:
+                relay_h = state.spans.begin(
+                    "relay", component="router", request_id=rid,
+                    replica=name,
+                )
                 res = self._open_upstream(
-                    state.registry.url_of(name), body
+                    state.registry.url_of(name), body, headers
                 )
                 kind = res[0]
                 if kind == "refused":
@@ -425,12 +684,18 @@ def make_router_handler(state: RouterState):
                     state.m_requests.labels(
                         replica=name, outcome="refused"
                     ).inc()
+                    state.recorder.record(
+                        "router_spill", reason="refused", replica=name,
+                        request_id=rid,
+                    )
+                    state.spans.end(relay_h, outcome="refused")
                     continue
                 if kind == "stream":  # impossible for stream=False
                     res[1].close()
                     state.m_requests.labels(
                         replica=name, outcome="protocol"
                     ).inc()
+                    state.spans.end(relay_h, outcome="protocol")
                     continue
                 _, status, data, retry_after = res
                 if status in (429, 503):
@@ -438,6 +703,11 @@ def make_router_handler(state: RouterState):
                     state.m_requests.labels(
                         replica=name, outcome="shed"
                     ).inc()
+                    state.recorder.record(
+                        "router_spill", reason="shed", replica=name,
+                        request_id=rid, status=status,
+                    )
+                    state.spans.end(relay_h, outcome="shed")
                     continue
                 state.m_requests.labels(
                     replica=name,
@@ -445,11 +715,15 @@ def make_router_handler(state: RouterState):
                 ).inc()
                 if name == plan.target:
                     state.m_affinity_hits.inc()
+                state.ledger.touch(rid, name)
+                state.spans.end(relay_h, outcome=f"http_{status}")
                 self.send_response(status)
                 self.send_header(
                     "Content-Type", "application/json; charset=utf-8"
                 )
                 self.send_header("Content-Length", str(len(data)))
+                self.send_header("x-dllama-request", rid)
+                self.send_header("x-dllama-trace", trace)
                 self.end_headers()
                 self.wfile.write(data)
                 return
@@ -470,11 +744,16 @@ def make_router_handler(state: RouterState):
 
         # -------------------------------------------------- stream relay
 
-        def _open_upstream(self, base_url: str, req_body: dict):
+        def _open_upstream(
+            self, base_url: str, req_body: dict,
+            headers: dict | None = None,
+        ):
             """POST to a replica. Returns one of
             ``("stream", conn, resp)`` (SSE accepted),
             ``("response", status, body_bytes, retry_after)``, or
-            ``("refused", reason)`` (connect/send failure)."""
+            ``("refused", reason)`` (connect/send failure). ``headers``
+            carries the trace-propagation pair on every issue AND
+            re-issue, so failed-over requests keep their identity."""
             u = urlsplit(base_url)
             conn = http.client.HTTPConnection(
                 u.hostname, u.port, timeout=state.stall_timeout_s
@@ -484,7 +763,7 @@ def make_router_handler(state: RouterState):
                     "POST",
                     "/v1/chat/completions",
                     json.dumps(req_body),
-                    {"Content-Type": "application/json"},
+                    {"Content-Type": "application/json", **(headers or {})},
                 )
                 resp = conn.getresponse()
             except OSError as e:
@@ -509,13 +788,21 @@ def make_router_handler(state: RouterState):
             _sse_write(self.wfile, "data: [DONE]\r\n\r\n")
             self.wfile.write(b"0\r\n\r\n")
 
-        def _sse_headers(self) -> None:
+        def _sse_headers(
+            self, rid: str | None = None, trace: str | None = None
+        ) -> None:
             self.send_response(200)
             self.send_header("Access-Control-Allow-Origin", "*")
             self.send_header(
                 "Content-Type", "text/event-stream; charset=utf-8"
             )
             self.send_header("Transfer-Encoding", "chunked")
+            if rid is not None:
+                # echo the fleet identity so clients/tests can fetch the
+                # stitched timeline for the stream they just consumed
+                self.send_header("x-dllama-request", rid)
+            if trace is not None:
+                self.send_header("x-dllama-trace", trace)
             self.end_headers()
 
         def _synth_delta(self, text: str) -> dict:
@@ -539,10 +826,13 @@ def make_router_handler(state: RouterState):
             """Relay one upstream SSE stream until ``[DONE]``, keeping
             the failover books: ``emitted`` (generated token ids),
             ``exact`` (exact consumed text via dllama_piece) and
-            ``relayed`` (delta text the client has). Raises _StreamDeath
-            on EOF / stall / retryable error; raises OSError if OUR
-            client's socket fails."""
+            ``relayed`` (delta text the client has) plus ``t_last`` (the
+            clock at the last relayed frame — the stall-detect span's
+            retroactive start). Raises _StreamDeath on EOF / stall /
+            retryable error; raises OSError if OUR client's socket
+            fails."""
             while True:
+                book["t_last"] = time.perf_counter()
                 try:
                     line = resp.readline()
                 except (
@@ -599,15 +889,24 @@ def make_router_handler(state: RouterState):
                     book["relayed"] += text
 
         def _relay_stream(
-            self, body: dict, prompt_tokens: list[int], plan: RoutePlan
+            self, body: dict, prompt_tokens: list[int], plan: RoutePlan,
+            rid: str, trace: str,
         ) -> None:
             """Stream with mid-stream failover (the tentpole headline);
-            see the module docstring for the resume contract."""
+            see the module docstring for the resume contract. Every
+            attempt is a router ``relay`` span; a death opens a
+            ``failover`` span that stays open across the re-issue and
+            ends when the catch-up delta lands from the sibling — THAT
+            span is the client-visible gap, and its duration feeds
+            ``dllama_router_failover_gap_seconds``."""
             book: dict = {"emitted": [], "exact": "", "relayed": ""}
+            headers = {"x-dllama-trace": trace, "x-dllama-request": rid}
             max_tokens = int(body.get("max_tokens", -1) or -1)
             started = False     # SSE headers sent to OUR client
             first_replica = None
             failovers = 0
+            gap_h = None        # open failover span (death -> caught up)
+            gap_t0 = None
             try:
                 for name in plan.candidates:
                     resuming = bool(book["emitted"])
@@ -624,8 +923,12 @@ def make_router_handler(state: RouterState):
                             upstream["max_tokens"] = max(
                                 1, max_tokens - len(book["emitted"])
                             )
+                    relay_h = state.spans.begin(
+                        "relay", component="router", request_id=rid,
+                        replica=name, resumed=resuming,
+                    )
                     res = self._open_upstream(
-                        state.registry.url_of(name), upstream
+                        state.registry.url_of(name), upstream, headers
                     )
                     kind = res[0]
                     if kind == "refused":
@@ -634,6 +937,11 @@ def make_router_handler(state: RouterState):
                         state.m_requests.labels(
                             replica=name, outcome="refused"
                         ).inc()
+                        state.recorder.record(
+                            "router_spill", reason="refused",
+                            replica=name, request_id=rid,
+                        )
+                        state.spans.end(relay_h, outcome="refused")
                         continue
                     if kind == "response":
                         _, status, data, _ra = res
@@ -642,6 +950,12 @@ def make_router_handler(state: RouterState):
                             state.m_requests.labels(
                                 replica=name, outcome="shed"
                             ).inc()
+                            state.recorder.record(
+                                "router_spill", reason="shed",
+                                replica=name, request_id=rid,
+                                status=status,
+                            )
+                            state.spans.end(relay_h, outcome="shed")
                             continue
                         # non-retryable upstream answer (e.g. 400): if
                         # the client stream hasn't started, forward it;
@@ -649,6 +963,9 @@ def make_router_handler(state: RouterState):
                         state.m_requests.labels(
                             replica=name, outcome=f"http_{status}"
                         ).inc()
+                        state.spans.end(
+                            relay_h, outcome=f"http_{status}"
+                        )
                         if not started:
                             self.send_response(status)
                             self.send_header(
@@ -665,22 +982,64 @@ def make_router_handler(state: RouterState):
                     _, conn, resp = res
                     if first_replica is None:
                         first_replica = name
+                    state.ledger.touch(rid, name)
                     if not started:
-                        self._sse_headers()
+                        self._sse_headers(rid, trace)
                         started = True
                     if resuming:
                         # catch-up: exact consumed text the dead replica
                         # never flushed (its detector holdback). After
                         # this, relayed == exact and the sibling's fresh
                         # deltas append cleanly.
-                        gap = book["exact"][len(book["relayed"]):]
-                        if gap:
-                            self._client_chunk(self._synth_delta(gap))
-                            book["relayed"] += gap
+                        with state.spans.span(
+                            "catch_up_synthesis", component="router",
+                            request_id=rid, replica=name,
+                        ) as catch_h:
+                            gap = book["exact"][len(book["relayed"]):]
+                            if gap:
+                                self._client_chunk(self._synth_delta(gap))
+                                book["relayed"] += gap
+                            state.spans.end(
+                                catch_h, catch_up_chars=len(gap)
+                            )
+                        # the client is whole again: close the gap span
+                        # and book the recovery latency
+                        state.spans.end(gap_h, to_replica=name)
+                        gap_h = None
+                        if gap_t0 is not None:
+                            gap_s = time.perf_counter() - gap_t0
+                            state.m_gap.observe(gap_s)
+                            state.ledger.close_failover(rid, name, gap_s)
+                            gap_t0 = None
                     try:
                         self._relay_frames(resp, book)
                     except _StreamDeath as death:
                         conn.close()
+                        reason = str(death)
+                        state.spans.end(
+                            relay_h, outcome="died", reason=reason
+                        )
+                        if reason.startswith("read_Timeout"):
+                            # a stall, not a crash: the socket was alive
+                            # but silent past the watchdog timeout
+                            state.m_stalls.inc()
+                            state.recorder.record(
+                                "router_stall", replica=name,
+                                request_id=rid,
+                                stall_timeout_s=state.stall_timeout_s,
+                            )
+                            # retroactive stall-detect span: it BEGAN at
+                            # the last relayed frame, we only know now
+                            stall_h = state.spans.begin(
+                                "stall_detect", component="router",
+                                request_id=rid, replica=name,
+                            )
+                            if (
+                                stall_h is not None
+                                and book.get("t_last") is not None
+                            ):
+                                stall_h.t0 = book["t_last"]
+                            state.spans.end(stall_h)
                         state.m_failovers.inc()
                         state.m_requests.labels(
                             replica=name, outcome="died"
@@ -688,9 +1047,22 @@ def make_router_handler(state: RouterState):
                         state.recorder.record(
                             "router_failover",
                             replica=name,
-                            reason=str(death),
+                            reason=reason,
+                            emitted_tokens=len(book["emitted"]),
+                            request_id=rid,
+                            trace_id=trace,
+                        )
+                        state.ledger.failover(
+                            rid, from_replica=name, reason=reason,
                             emitted_tokens=len(book["emitted"]),
                         )
+                        gap_h = state.spans.begin(
+                            "failover", component="router",
+                            request_id=rid, from_replica=name,
+                            reason=reason,
+                            emitted_tokens=len(book["emitted"]),
+                        )
+                        gap_t0 = time.perf_counter()
                         failovers += 1
                         if failovers > state.failover_max:
                             break
@@ -698,6 +1070,11 @@ def make_router_handler(state: RouterState):
                     # clean end: upstream sent finish (or a
                     # non-retryable error frame) then [DONE]
                     conn.close()
+                    state.spans.end(
+                        relay_h,
+                        outcome="error" if "error" in book else "ok",
+                        relayed_tokens=len(book["emitted"]),
+                    )
                     state.m_requests.labels(
                         replica=name,
                         outcome="error" if "error" in book else "ok",
@@ -707,6 +1084,8 @@ def make_router_handler(state: RouterState):
                     self._client_done()
                     return
                 # candidates (or the failover budget) exhausted
+                state.spans.end(gap_h, outcome="lost")
+                gap_h = None
                 state.m_requests.labels(
                     replica="none", outcome="unavailable"
                 ).inc()
@@ -775,6 +1154,11 @@ def serve_router(
     registry.poll_once()  # seed states before the first request
     if start_poller:
         registry.start()
+        # the fleet sampler rides the poller decision: tests that drive
+        # polls synchronously also drive scrapes/samples synchronously
+        # (state.fleet.sampler / scrape_once), so no background thread
+        # races their assertions
+        state.fleet.start()
     server = ThreadingHTTPServer((host, port), make_router_handler(state))
     server.state = state
     inner_close = server.server_close
@@ -782,6 +1166,7 @@ def serve_router(
     def _close_and_stop():
         inner_close()
         registry.stop()
+        state.fleet.close()
 
     server.server_close = _close_and_stop
     return server

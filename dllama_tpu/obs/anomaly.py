@@ -324,6 +324,14 @@ def _level(store: SeriesStore, name: str) -> Callable[[], float | None]:
     return fn
 
 
+# public value-fn builders: external rule sets (fleet/obs.py builds its
+# fleet-level rules over the router's series store) compose the same
+# primitives the default rules use
+level = _level
+slope = _slope
+per_event_rate = _per_event_rate
+
+
 # the series behind the default rules, in one place: postmortem dumps
 # embed the trailing window of exactly these signals (obs/recorder.py
 # context providers), so a ring dump carries the same evidence the live

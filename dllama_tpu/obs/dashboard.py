@@ -15,6 +15,14 @@ The default panel set covers the signals an operator watches first
 box adds any other series the store tracks. Rendering is deliberately
 dumb — a fetch loop and ~40 lines of canvas — because the page must
 work from ``curl -o dash.html`` on an air-gapped host.
+
+The SAME page serves as the fleet dashboard on the router (ISSUE 19):
+when the series index advertises ``dllama_fleet_goodput_tokens_per_s``,
+the fleet default panels (aggregate goodput, TPOT skew, per-replica
+TPOT, affinity hit rate, failovers) are appended, and every panel
+overlays the ``replica``-labelled variants of its base series as
+separate colored lines — one sparkline per replica, the skew visible at
+a glance.
 """
 
 from __future__ import annotations
@@ -84,7 +92,18 @@ const DEFAULTS = [
   "dllama_kv_pages_free",
   "dllama_spec_acceptance_rate",
 ];
+const FLEET_DEFAULTS = [
+  "dllama_fleet_goodput_tokens_per_s",
+  "dllama_fleet_tpot_skew_ms",
+  "dllama_fleet_replica_tpot_p50_ms",
+  "dllama_fleet_affinity_hit_rate",
+  "dllama_router_failovers_total",
+];
+const PALETTE = ["#58a6ff", "#7ce38b", "#ffa657", "#d2a8ff",
+                 "#ff8f8f", "#79c0ff"];
 let series = DEFAULTS.slice();
+let fleetAdded = false;
+let indexNames = [];
 const grid = document.getElementById("grid");
 const panels = {};
 
@@ -99,24 +118,44 @@ function panelFor(name) {
   return div;
 }
 
-function spark(canvas, pts) {
+function spark(canvas, lines) {
+  // lines: array of point arrays, one colored polyline each (line 0 is
+  // the base series; 1.. are per-replica overlays). Shared y-scale so
+  // replica skew reads directly off the vertical spread.
   const dpr = window.devicePixelRatio || 1;
   const w = canvas.clientWidth * dpr, h = canvas.clientHeight * dpr;
   canvas.width = w; canvas.height = h;
   const ctx = canvas.getContext("2d");
   ctx.clearRect(0, 0, w, h);
-  if (pts.length < 2) return;
-  let lo = Infinity, hi = -Infinity;
-  for (const [, v] of pts) { lo = Math.min(lo, v); hi = Math.max(hi, v); }
+  let lo = Infinity, hi = -Infinity, t0 = Infinity, t1 = -Infinity;
+  for (const pts of lines) {
+    for (const [t, v] of pts) {
+      lo = Math.min(lo, v); hi = Math.max(hi, v);
+      t0 = Math.min(t0, t); t1 = Math.max(t1, t);
+    }
+  }
+  if (!isFinite(lo)) return;
   if (hi === lo) { hi = lo + 1; }
-  const t0 = pts[0][0], t1 = pts[pts.length - 1][0] || t0 + 1;
-  ctx.strokeStyle = "#58a6ff"; ctx.lineWidth = 1.5 * dpr; ctx.beginPath();
-  pts.forEach(([t, v], i) => {
-    const x = ((t - t0) / Math.max(t1 - t0, 1e-9)) * (w - 2) + 1;
-    const y = h - 3 - ((v - lo) / (hi - lo)) * (h - 6);
-    if (i === 0) ctx.moveTo(x, y); else ctx.lineTo(x, y);
+  lines.forEach((pts, li) => {
+    if (pts.length < 2) return;
+    ctx.strokeStyle = PALETTE[li % PALETTE.length];
+    ctx.lineWidth = 1.5 * dpr; ctx.beginPath();
+    pts.forEach(([t, v], i) => {
+      const x = ((t - t0) / Math.max(t1 - t0, 1e-9)) * (w - 2) + 1;
+      const y = h - 3 - ((v - lo) / (hi - lo)) * (h - 6);
+      if (i === 0) ctx.moveTo(x, y); else ctx.lineTo(x, y);
+    });
+    ctx.stroke();
   });
-  ctx.stroke();
+}
+
+function replicaVariants(name) {
+  // the router's series store tracks the scraped per-replica children
+  // as name{replica="r0",...}; overlay them on the base panel
+  if (name.includes("{")) return [];
+  const prefix = name + "{";
+  return indexNames.filter(
+    (n) => n.startsWith(prefix) && n.includes('replica="'));
 }
 
 async function getJSON(url) {
@@ -145,26 +184,38 @@ async function tick() {
   } catch (e) { /* server restarting; keep polling */ }
   try {
     const idx = await getJSON("/v1/debug/series");
+    indexNames = idx.names || [];
     const dl = document.getElementById("names");
     dl.innerHTML = "";
-    for (const n of idx.names || []) {
+    for (const n of indexNames) {
       const o = document.createElement("option");
       o.value = n; dl.appendChild(o);
+    }
+    if (!fleetAdded &&
+        indexNames.includes("dllama_fleet_goodput_tokens_per_s")) {
+      // we are pointed at a fleet router: append the fleet panels once
+      fleetAdded = true;
+      for (const n of FLEET_DEFAULTS) {
+        if (!series.includes(n)) series.push(n);
+      }
     }
   } catch (e) { /* ignore */ }
   for (const name of series) {
     const div = panelFor(name);
-    try {
-      const s = await getJSON(
-        "/v1/debug/series?name=" + encodeURIComponent(name) +
-        "&window=" + win);
-      const pts = s.points || [];
-      div.querySelector(".val").textContent =
-        pts.length ? fmt(pts[pts.length - 1][1]) : "—";
-      spark(div.querySelector("canvas"), pts);
-    } catch (e) {
-      div.querySelector(".val").textContent = "—";
+    const lines = [];
+    let last = null;
+    for (const n of [name].concat(replicaVariants(name))) {
+      try {
+        const s = await getJSON(
+          "/v1/debug/series?name=" + encodeURIComponent(n) +
+          "&window=" + win);
+        const pts = s.points || [];
+        lines.push(pts);
+        if (last === null && pts.length) last = pts[pts.length - 1][1];
+      } catch (e) { /* series missing; panel shows a dash */ }
     }
+    div.querySelector(".val").textContent = fmt(last);
+    spark(div.querySelector("canvas"), lines);
   }
 }
 

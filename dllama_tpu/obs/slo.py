@@ -43,6 +43,11 @@ WINDOWS: tuple[tuple[float, str], ...] = (
     (10.0, "10s"), (60.0, "1m"), (300.0, "5m"),
 )
 
+# the goodput gauge family name, exported so fleet-level consumers
+# (fleet/obs.py scrapes it per replica and sums the 1m window) don't
+# hardcode a string that must match the registration below
+GOODPUT_METRIC = "dllama_slo_goodput_tokens_per_s"
+
 
 def _env_float(name: str) -> float | None:
     v = os.environ.get(name, "")
@@ -102,6 +107,8 @@ class SloTracker:
             "ALL configured SLO targets.",
             labelnames=("window",),
         )
+        # NOTE: literal name (not GOODPUT_METRIC) so the metrics-docs
+        # lint sees the registration; the constant mirrors it for readers
         self.g_goodput = obs.gauge(
             "dllama_slo_goodput_tokens_per_s",
             "Completion tokens/s inside the window counting ONLY requests "

@@ -50,20 +50,41 @@ DEFAULT_CAPACITY = 4096
 # stable Chrome-trace pid per component (new components get the next id)
 _COMPONENT_PIDS = {"scheduler": 1, "engine": 2, "kv": 3, "http": 4, "cli": 5}
 
+# Replica attribution (ISSUE 19): the in-process fleet shares ONE global
+# tracker across N replicas, so span records carry the replica that
+# produced them. The tag is registered per-thread (scheduler loop + HTTP
+# handler threads are replica-owned; engine compile/prefetch helpers stay
+# untagged) and stamped at ``begin`` — a span that begins on a replica
+# thread and ends elsewhere keeps its origin.
+_thread_ctx = threading.local()
+
+
+def set_thread_replica(tag: str | None) -> None:
+    """Tag every span subsequently begun on THIS thread with a replica
+    name (``None`` clears). Single-replica servers never call this and
+    their span records are unchanged."""
+    _thread_ctx.replica = tag
+
+
+def get_thread_replica() -> str | None:
+    return getattr(_thread_ctx, "replica", None)
+
 
 class _SpanHandle:
     """In-flight span state between ``begin`` and ``end``."""
 
     __slots__ = ("name", "component", "request_id", "lane", "t0", "attrs",
-                 "done")
+                 "replica", "done")
 
-    def __init__(self, name, component, request_id, lane, t0, attrs):
+    def __init__(self, name, component, request_id, lane, t0, attrs,
+                 replica=None):
         self.name = name
         self.component = component
         self.request_id = request_id
         self.lane = lane
         self.t0 = t0
         self.attrs = attrs
+        self.replica = replica
         self.done = False
 
 
@@ -119,7 +140,8 @@ class SpanTracker:
         if not self.enabled:
             return None
         return _SpanHandle(
-            name, component, request_id, lane, self._clock(), attrs or None
+            name, component, request_id, lane, self._clock(), attrs or None,
+            replica=get_thread_replica(),
         )
 
     def end(self, handle: _SpanHandle | None, **attrs) -> None:
@@ -139,6 +161,8 @@ class SpanTracker:
             "t0": handle.t0 - self._epoch,
             "dur_s": max(t1 - handle.t0, 0.0),
         }
+        if handle.replica is not None:
+            rec["replica"] = handle.replica
         if handle.attrs:
             rec["attrs"] = handle.attrs
         overflowed = False
@@ -172,11 +196,14 @@ class SpanTracker:
 
     # -- views -------------------------------------------------------------
 
-    def completed(self, request_id: str | None = None) -> list[dict]:
+    def completed(self, request_id: str | None = None,
+                  replica: str | None = None) -> list[dict]:
         with self._lock:
             spans = list(self._ring)
         if request_id is not None:
             spans = [s for s in spans if s["request_id"] == request_id]
+        if replica is not None:
+            spans = [s for s in spans if s.get("replica") == replica]
         return spans
 
     @property
@@ -195,13 +222,22 @@ class SpanTracker:
 
     # -- Chrome-trace / Perfetto export ------------------------------------
 
-    def chrome_trace(self, request_id: str | None = None) -> dict:
+    def chrome_trace(self, request_id: str | None = None,
+                     replica: str | None = None,
+                     pid_prefix: str | None = None,
+                     pid_base: int = 0) -> dict:
         """Chrome-trace JSON-object format (loadable by Perfetto and
         chrome://tracing): one complete ("X") event per span, pid =
         component, tid = lane (-1 = no lane), ts/dur in microseconds
         since the tracker epoch. Extra top-level keys (the per-request
-        summary under "dllama") are legal metadata both viewers ignore."""
-        spans = self.completed(request_id)
+        summary under "dllama") are legal metadata both viewers ignore.
+
+        ``replica`` keeps only spans stamped with that replica tag (the
+        in-process fleet shares one tracker). ``pid_prefix`` prefixes
+        every process name and ``pid_base`` offsets every pid, so a fleet
+        stitcher can merge N fragments without two replicas' identical
+        component names/pids colliding in the viewer (ISSUE 19)."""
+        spans = self.completed(request_id, replica)
         events: list[dict] = []
         seen_pids: dict[str, int] = {}
         seen_tids: set[tuple[int, int]] = set()
@@ -212,12 +248,17 @@ class SpanTracker:
                 pid = _COMPONENT_PIDS.setdefault(
                     comp, max(_COMPONENT_PIDS.values()) + 1
                 )
+            pid += pid_base
             tid = s["lane"] if s["lane"] is not None else -1
             if comp not in seen_pids:
                 seen_pids[comp] = pid
                 events.append({
                     "ph": "M", "pid": pid, "tid": 0,
-                    "name": "process_name", "args": {"name": comp},
+                    "name": "process_name",
+                    "args": {
+                        "name": f"{pid_prefix}/{comp}" if pid_prefix
+                        else comp
+                    },
                 })
             if (pid, tid) not in seen_tids:
                 seen_tids.add((pid, tid))
@@ -228,6 +269,12 @@ class SpanTracker:
                         "name": f"lane {tid}" if tid >= 0 else "no lane"
                     },
                 })
+            args = {
+                "request_id": s["request_id"],
+                **(s.get("attrs") or {}),
+            }
+            if s.get("replica") is not None:
+                args["replica"] = s["replica"]
             ev = {
                 "ph": "X",
                 "pid": pid,
@@ -235,10 +282,7 @@ class SpanTracker:
                 "ts": round(s["t0"] * 1e6, 3),
                 "dur": round(s["dur_s"] * 1e6, 3),
                 "name": s["name"],
-                "args": {
-                    "request_id": s["request_id"],
-                    **(s.get("attrs") or {}),
-                },
+                "args": args,
             }
             events.append(ev)
         out = {
@@ -250,6 +294,8 @@ class SpanTracker:
                 "dropped": self.dropped,
             },
         }
+        if replica is not None:
+            out["dllama"]["replica"] = replica
         if request_id is not None:
             out["dllama"]["request_id"] = request_id
             out["dllama"]["summary"] = self.request_summary(request_id)

@@ -39,9 +39,14 @@ class RequestSpan:
     are seconds on the monotonic clock, ``submitted_unix`` is wall time."""
 
     def __init__(self, tracer: "Tracer | None", request_id: str | None = None,
-                 path: str = "lanes") -> None:
+                 path: str = "lanes", trace_id: str | None = None) -> None:
         self.tracer = tracer
         self.request_id = request_id or f"req-{uuid.uuid4().hex[:12]}"
+        # fleet-level identity (ISSUE 19): the router mints one trace id
+        # per client request and forwards it on every relay INCLUDING
+        # failover re-issues, so the same trace id lands in every replica
+        # that touched the request. None outside a fleet.
+        self.trace_id = trace_id
         self.path = path
         self.submitted_unix = time.time()
         self.t_submit = time.perf_counter()
@@ -116,6 +121,7 @@ class RequestSpan:
     def to_record(self) -> dict:
         return {
             "request_id": self.request_id,
+            "trace_id": self.trace_id,
             "path": self.path,
             "submitted_unix": round(self.submitted_unix, 6),
             "lane": self.lane,
@@ -165,8 +171,8 @@ class Tracer:
             self._sink = open(sink_path, "a", buffering=1)
 
     def span(self, request_id: str | None = None,
-             path: str = "lanes") -> RequestSpan:
-        return RequestSpan(self, request_id, path)
+             path: str = "lanes", trace_id: str | None = None) -> RequestSpan:
+        return RequestSpan(self, request_id, path, trace_id=trace_id)
 
     def record(self, rec: dict) -> None:
         line = _dumps_safe(rec)

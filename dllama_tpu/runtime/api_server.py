@@ -64,7 +64,7 @@ from ..obs.device import compare_with_analytic, sample_device_memory
 from ..obs.metrics import DEFAULT_TOKEN_BUCKETS_S, get_registry
 from ..obs.recorder import get_recorder
 from ..obs.slo import SloTracker, resolve_slo_knobs
-from ..obs.spans import get_span_tracker
+from ..obs.spans import get_span_tracker, set_thread_replica
 from ..obs.timeseries import (
     MetricsSampler,
     SeriesStore,
@@ -178,6 +178,13 @@ class InferenceParams:
     # their raw decoded piece text (dllama_tokens / dllama_piece chunk
     # fields) so a router can reconstruct the token history mid-stream
     include_tokens: bool = False
+    # fleet trace propagation (ISSUE 19): the router mints a trace id +
+    # request id per client request and forwards them as x-dllama-trace /
+    # x-dllama-request on every relay INCLUDING failover re-issues;
+    # admission adopts them so replica spans, recorder events and trace
+    # JSONL carry fleet-level identity. None outside a fleet.
+    trace_id: str | None = None
+    request_id: str | None = None
 
 
 class LaneJob:
@@ -482,7 +489,13 @@ class LaneScheduler:
 
     def submit(self, params: InferenceParams) -> LaneJob:
         job = LaneJob(params)
-        job.span = self.state.tracer.span(path="lanes")
+        # adopt router-propagated identity when present: the span's
+        # request id (and thus every timeline span keyed on it) is the
+        # FLEET request id, so a failover's two half-timelines share it
+        job.span = self.state.tracer.span(
+            request_id=params.request_id, path="lanes",
+            trace_id=params.trace_id,
+        )
         # queue span: begins here on the handler thread, ends on the
         # scheduler thread once admission work (tokenize + radix match)
         # is done — so timeline "queue" covers wait AND admission setup
@@ -683,6 +696,11 @@ class LaneScheduler:
     # -- scheduler thread --------------------------------------------------
 
     def _loop(self) -> None:
+        if self.state.replica_id is not None:
+            # the scheduler thread is replica-owned for its lifetime:
+            # every span it begins (admission, decode, publish, park)
+            # carries the replica tag (obs/spans.py, ISSUE 19)
+            set_thread_replica(self.state.replica_id)
         while True:
             with self.cv:
                 while (
@@ -2512,6 +2530,8 @@ def make_handler(state: ApiState):
 
         def do_GET(self):
             self._count_request()
+            if state.replica_id is not None:
+                set_thread_replica(state.replica_id)
             # /v1/debug/timeline takes ?request_id=...; parse by hand so
             # the other exact-match branches tolerate stray queries too
             path, _, query = self.path.partition("?")
@@ -2590,9 +2610,22 @@ def make_handler(state: ApiState):
             elif path == "/v1/debug/timeline":
                 # Chrome-trace / Perfetto JSON of the span ring; with
                 # ?request_id= it narrows to one request and adds its
-                # millisecond-accounting summary under "dllama"
+                # millisecond-accounting summary under "dllama". The
+                # fleet stitcher adds ?replica= (keep only that replica's
+                # spans — the in-process fleet shares one tracker),
+                # ?pid_prefix= and ?pid_base= so merged fragments don't
+                # collide (obs/spans.py, ISSUE 19)
                 rid = (params.get("request_id") or [None])[0]
-                self._json(state.spans.chrome_trace(request_id=rid))
+                rep = (params.get("replica") or [None])[0]
+                prefix = (params.get("pid_prefix") or [None])[0]
+                try:
+                    base = int((params.get("pid_base") or ["0"])[0])
+                except ValueError:
+                    base = 0
+                self._json(state.spans.chrome_trace(
+                    request_id=rid, replica=rep, pid_prefix=prefix,
+                    pid_base=base,
+                ))
             elif path == "/v1/debug/slo":
                 self._json(state.slo.snapshot())
             elif path == "/v1/debug/series":
@@ -2643,6 +2676,11 @@ def make_handler(state: ApiState):
 
         def do_POST(self):
             self._count_request()
+            if state.replica_id is not None:
+                # replica-attributed spans (ISSUE 19): handler threads are
+                # per-request, so tag each one; the in-process fleet's
+                # shared tracker then knows which replica each span is
+                set_thread_replica(state.replica_id)
             path = self.path.partition("?")[0]
             if path == "/v1/debug/profile":
                 self._profile()
@@ -2662,6 +2700,16 @@ def make_handler(state: ApiState):
             except (ValueError, KeyError, TypeError) as e:
                 self._json({"error": {"message": f"bad request: {e}"}}, 400)
                 return
+
+            if params.trace_id is not None:
+                # fleet identity adopted: leave a recorder trail BEFORE
+                # the shed gate so even refused relays are attributable
+                state.recorder.record(
+                    "trace_adopt", trace_id=params.trace_id,
+                    request_id=params.request_id,
+                    replica=state.replica_id,
+                    resumed=params.resume_tokens is not None,
+                )
 
             # load shedding BEFORE the request touches the queue or the
             # engine lock: a refused request costs the server nothing
@@ -2703,7 +2751,10 @@ def make_handler(state: ApiState):
                     400,
                 )
                 return
-            span = state.tracer.span(path="single")
+            span = state.tracer.span(
+                request_id=params.request_id, path="single",
+                trace_id=params.trace_id,
+            )
             with state.lock:
                 # queue wait on this path is the engine-lock wait
                 state.m_queue_wait.observe(span.mark_admitted())
@@ -2994,6 +3045,14 @@ def make_handler(state: ApiState):
                 if priority not in ("low", "normal", "high"):
                     raise ValueError(f"unknown priority {priority!r}")
                 params.priority = priority
+            # fleet trace propagation (ISSUE 19): adopt the router-minted
+            # identity headers; absent outside a fleet
+            trace_id = self.headers.get("x-dllama-trace")
+            request_id = self.headers.get("x-dllama-request")
+            if trace_id:
+                params.trace_id = str(trace_id)
+            if request_id:
+                params.request_id = str(request_id)
             return params
 
     return Handler

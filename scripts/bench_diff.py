@@ -58,6 +58,10 @@ WATCHED: dict[str, str] = {
     # round: a drop means sibling continuations stopped reaching the
     # drafter through the shared n-gram store (ISSUE 18)
     "SERVING.speculation_nl.tok_s_shared": "higher",
+    # the failover tax a client actually feels: dead air between the
+    # victim's last relayed byte and the sibling's catch-up chunk on the
+    # seeded kill round (ISSUE 19)
+    "SERVING.fleet.fleet_obs.failover_gap_ms_p99": "lower",
 }
 
 

@@ -199,6 +199,189 @@ def test_resolve_fleet_knobs(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# fleet observability plane units (fast lane)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_resolve_fleet_obs_knobs(monkeypatch):
+    from dllama_tpu.fleet.obs import resolve_fleet_obs_knobs
+
+    monkeypatch.setenv("DLLAMA_FLEET_OBS_INTERVAL_S", "0.5")
+    monkeypatch.setenv("DLLAMA_FLEET_OBS_LEDGER", "64")
+    interval, retention, cap = resolve_fleet_obs_knobs()
+    assert (interval, cap) == (0.5, 64)
+    # explicit beats env
+    interval2, retention2, _ = resolve_fleet_obs_knobs(
+        interval_s=2.0, retention_s=60.0
+    )
+    assert (interval2, retention2) == (2.0, 60.0)
+    with pytest.raises(ValueError):
+        resolve_fleet_obs_knobs(interval_s=0.0)
+
+
+@pytest.mark.fast
+def test_prom_text_parse_relabel_quantile():
+    from dllama_tpu.fleet.obs import (
+        histogram_quantile,
+        parse_prom_text,
+        relabel_prom_text,
+    )
+
+    text = (
+        "# HELP dllama_tpot_seconds per-token latency\n"
+        'dllama_tpot_seconds_bucket{le="0.01"} 4\n'
+        'dllama_tpot_seconds_bucket{le="0.02"} 10\n'
+        'dllama_tpot_seconds_bucket{le="+Inf"} 10\n'
+        'dllama_slo_goodput_tokens_per_s{window="1m"} 42.5\n'
+        "dllama_lanes_active 2\n"
+        'dllama_router_requests_total{replica="r0",outcome="ok"} 3\n'
+    )
+    series = parse_prom_text(text)
+    assert ("dllama_lanes_active", {}, 2.0) in series
+    assert (
+        "dllama_slo_goodput_tokens_per_s", {"window": "1m"}, 42.5
+    ) in series
+    # PromQL-style interpolation: target rank 5 sits 1/6 into the
+    # (0.01, 0.02] bucket
+    p50 = histogram_quantile(series, "dllama_tpot_seconds", 0.5)
+    assert abs(p50 - (0.01 + (1 / 6) * 0.01)) < 1e-9
+    assert histogram_quantile(series, "dllama_absent", 0.5) is None
+    out = relabel_prom_text(
+        text, "r1", skip_prefixes=("dllama_router_",)
+    )
+    # every kept line gains replica= as FIRST label; comments and the
+    # router's own families are dropped (no recursion in data form)
+    assert '{replica="r1",window="1m"} 42.5' in out
+    assert 'dllama_lanes_active{replica="r1"} 2' in out
+    assert "# HELP" not in out and "dllama_router_requests" not in out
+
+
+@pytest.mark.fast
+def test_request_ledger_and_stitching():
+    from dllama_tpu.fleet.obs import RequestLedger, stitch_timelines
+
+    ledger = RequestLedger(capacity=2)
+    ledger.open("a", "trace-a")
+    ledger.touch("a", "r0")
+    ledger.touch("a", "r0")  # no-change touches don't duplicate
+    ledger.failover("a", from_replica="r0", reason="eof",
+                    emitted_tokens=3)
+    ledger.close_failover("a", "r1", 0.25)
+    ledger.touch("a", "r1")
+    e = ledger.get("a")
+    assert e["trace_id"] == "trace-a"
+    assert e["replicas"] == ["r0", "r1"]
+    assert e["failovers"] == [{
+        "from": "r0", "to": "r1", "reason": "eof",
+        "emitted_tokens": 3, "gap_s": 0.25,
+    }]
+    # bounded FIFO: two more opens evict the oldest
+    ledger.open("b", "t-b")
+    ledger.open("c", "t-c")
+    assert ledger.get("a") is None
+    assert [r["request_id"] for r in ledger.recent()] == ["c", "b"]
+
+    router = {
+        "traceEvents": [
+            {"ph": "X", "pid": 6, "tid": -1, "ts": 100.0, "dur": 5.0,
+             "name": "relay"},
+        ],
+        "dllama": {"epoch_unix": 1000.0},
+    }
+    frag = {
+        "traceEvents": [
+            {"ph": "M", "pid": 101, "tid": 0, "name": "process_name",
+             "args": {"name": "r0/http"}},
+            {"ph": "X", "pid": 101, "tid": 0, "ts": 50.0, "dur": 5.0,
+             "name": "queue"},
+        ],
+        "dllama": {"epoch_unix": 1002.5},
+    }
+    merged = stitch_timelines(router, [("r0", frag)])
+    assert merged["dllama"]["sources"] == {"router": 1, "r0": 1}
+    assert merged["dllama"]["n_spans"] == 2
+    xs = {e["name"]: e for e in merged["traceEvents"]
+          if e["ph"] == "X"}
+    # the fragment's ts rebases onto the router epoch: +2.5s in µs
+    assert xs["queue"]["ts"] == 50.0 + 2.5e6
+    assert xs["relay"]["ts"] == 100.0  # router events untouched
+    # metadata events survive the merge (Perfetto needs the pid names)
+    assert any(e["ph"] == "M" for e in merged["traceEvents"])
+
+
+def _fake_scrape(goodput, p50_ms):
+    """Prometheus text whose interpolated TPOT p50 is exactly p50_ms."""
+    le = p50_ms * 2.0 / 1000.0  # target rank falls mid-bucket
+    return (
+        f'dllama_slo_goodput_tokens_per_s{{window="1m"}} {goodput}\n'
+        f'dllama_tpot_seconds_bucket{{le="{le}"}} 10\n'
+        'dllama_tpot_seconds_bucket{le="+Inf"} 10\n'
+    )
+
+
+@pytest.mark.fast
+def test_fleet_anomaly_degrades_router_health(tmp_path):
+    """Acceptance: replica-labelled fleet aggregates drive a fleet
+    anomaly rule through router /v1/health degraded_reasons, fully
+    deterministic — fake clock, fake scrape fetch, no live replicas."""
+    from dllama_tpu.fleet.obs import FleetObs
+    from dllama_tpu.fleet.router import RouterState
+    from dllama_tpu.obs.metrics import MetricsRegistry
+    from dllama_tpu.obs.recorder import FlightRecorder
+    from dllama_tpu.tokenizer import Tokenizer
+
+    tp_ = str(tmp_path / "t.t")
+    make_tiny_tokenizer(tp_, chat_template="<|start_header_id|>")
+    clock = {"t": 0.0}
+    replica_reg = ReplicaRegistry(
+        {"r0": "http://r0", "r1": "http://r1"},
+        fetch=lambda url: {"status": "ok"},
+        clock=lambda: clock["t"],
+    )
+    replica_reg.poll_once()
+    skew = {"r1_p50_ms": 10.0}
+
+    def fetch(url):
+        p50 = 10.0 if "//r0" in url else skew["r1_p50_ms"]
+        return _fake_scrape(50.0, p50)
+
+    fobs = FleetObs(
+        replica_reg,
+        registry=MetricsRegistry(),
+        recorder=FlightRecorder(),
+        fetch=fetch,
+        clock=lambda: clock["t"],
+        interval_s=1.0,
+    )
+    state = RouterState(replica_reg, Tokenizer(tp_), fleet_obs=fobs)
+    # warm the EWMA baselines: both replicas agree, skew = 0
+    for _ in range(40):
+        clock["t"] += 1.0
+        fobs.sampler.sample_once(clock["t"])
+    assert not fobs.monitor.degraded
+    h = state.health_payload()
+    assert h["status"] == "ok" and h["degraded_reasons"] == []
+    # the scraped aggregates are live and replica-labelled
+    assert fobs.store.latest("dllama_fleet_goodput_tokens_per_s") == 100.0
+    assert fobs.store.latest(
+        'dllama_fleet_replica_tpot_p50_ms{replica="r1"}'
+    ) == 10.0
+    fleet_text = fobs.render_fleet()
+    assert '{replica="r0",window="1m"} 50.0' in fleet_text
+    # r1's TPOT p50 pulls away from its sibling: the skew rule fires
+    skew["r1_p50_ms"] = 300.0
+    clock["t"] += 1.0
+    fobs.sampler.sample_once(clock["t"])
+    assert fobs.monitor.degraded
+    assert "fleet_tpot_skew" in fobs.monitor.active_signals()
+    h = state.health_payload()
+    assert h["status"] == "degraded"
+    assert "fleet_anomaly:fleet_tpot_skew" in h["degraded_reasons"]
+    fobs.close()
+
+
+# ---------------------------------------------------------------------------
 # live 2-replica fleet (the CI fleet smoke)
 # ---------------------------------------------------------------------------
 
@@ -365,6 +548,165 @@ def test_midstream_failover_byte_identical(fleet):
         - _metric(before, "dllama_router_requests_total",
                   f'{{replica="{target}",outcome="died"}}')
     ) == 1.0
+
+
+def _stream_with_headers(url, payload):
+    """Like ``_stream`` but also returns the response headers (the
+    router echoes x-dllama-request / x-dllama-trace)."""
+    payload = dict(payload)
+    payload["stream"] = True
+    with _post(url, payload) as r:
+        headers = {k.lower(): v for k, v in r.headers.items()}
+        raw = r.read().decode()
+    events = [
+        json.loads(line[len("data: "):])
+        for line in raw.splitlines()
+        if line.startswith("data: ") and line != "data: [DONE]"
+    ]
+    assert all("error" not in e for e in events), events
+    text = "".join(
+        (e["choices"][0].get("delta") or {}).get("content") or ""
+        for e in events
+    )
+    return text, headers
+
+
+def test_trace_propagation_and_stitched_timeline(fleet):
+    """Satellite 3 + tentpole acceptance: a seeded mid-stream failover
+    leaves the SAME trace id in both replicas' trace sinks, and
+    /v1/fleet/timeline merges router + both replicas into one Perfetto
+    trace whose relay spans have zero overlap and whose gap is an
+    explicit attributed ``failover`` span."""
+    from dllama_tpu.runtime.faults import set_fault_plane
+
+    url = fleet.router_url
+    p = {"messages": [{"role": "user", "content": "stitch my timeline"}],
+         "max_tokens": 16, "temperature": 0}
+    state = fleet.router.state
+    victim = state.route(state.prompt_tokens(p["messages"])).target
+    sibling = next(n for n in fleet.replica_urls if n != victim)
+    set_fault_plane(f"sse_flush:op={victim}:nth=3:n=1")
+    try:
+        text, headers = _stream_with_headers(url, p)
+    finally:
+        set_fault_plane(None)
+    assert text
+    rid = headers["x-dllama-request"]
+    trace = headers["x-dllama-trace"]
+    assert rid.startswith("req-") and trace.startswith("trace-")
+    # the propagated trace id landed in BOTH replicas' trace sinks
+    by_name = dict(fleet.replicas)
+    for name in (victim, sibling):
+        recs = [
+            r for r in by_name[name].state.tracer.records()
+            if r.get("request_id") == rid
+        ]
+        assert recs, f"{name} recorded no trace for {rid}"
+        assert all(r["trace_id"] == trace for r in recs)
+    # ONE merged timeline: router + both replica fragments
+    tl = _get(f"{url}/v1/fleet/timeline?request_id={rid}")
+    d = tl["dllama"]
+    assert d["trace_id"] == trace
+    assert d["replicas"] == [victim, sibling]
+    assert "fetch_errors" not in d
+    assert d["sources"]["router"] > 0
+    assert d["sources"][victim] > 0 and d["sources"][sibling] > 0
+    xs = [e for e in tl["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in xs}
+    assert {"tokenize", "route_plan", "relay", "failover",
+            "catch_up_synthesis"} <= names
+    relays = sorted(
+        (e for e in xs if e["name"] == "relay"), key=lambda e: e["ts"]
+    )
+    assert len(relays) == 2
+    assert relays[0]["args"]["replica"] == victim
+    assert relays[0]["args"]["outcome"] == "died"
+    assert relays[1]["args"]["replica"] == sibling
+    assert relays[1]["args"]["resumed"] is True
+    (fail,) = [e for e in xs if e["name"] == "failover"]
+    assert fail["args"]["from_replica"] == victim
+    assert fail["args"]["to_replica"] == sibling
+    eps = 1.0  # µs rounding slop
+    # zero overlap: the victim relay ended before the sibling relay
+    # began, and the failover span is attributed to that gap
+    assert relays[0]["ts"] + relays[0]["dur"] <= relays[1]["ts"] + eps
+    assert fail["ts"] >= relays[0]["ts"] + relays[0]["dur"] - eps
+    assert (fail["ts"] + fail["dur"]
+            <= relays[1]["ts"] + relays[1]["dur"] + eps)
+    # the ledger attributed the hop and its client-visible gap
+    assert d["failovers"][0]["from"] == victim
+    assert d["failovers"][0]["to"] == sibling
+    assert d["failovers"][0]["gap_s"] > 0
+    # replica fragment events (pid-namespaced >= 100) carry the
+    # propagated request id
+    rep_events = [e for e in xs if e.get("pid", 0) >= 100]
+    assert rep_events
+    assert all(
+        e["args"].get("request_id") == rid for e in rep_events
+    )
+    # recovery latency booked in the router gap histogram
+    m = _scrape(url)
+    assert _metric(m, "dllama_router_failover_gap_seconds_count") >= 1.0
+    # the fleet postmortem dump: router events + every replica's ring
+    dump = _get(url + "/v1/fleet/debug/recorder")
+    assert set(dump["replicas"]) == {"r0", "r1"}
+    for repd in dump["replicas"].values():
+        assert "events" in repd
+    events = dump["router"]["events"]
+    fo = [e for e in events if e["kind"] == "router_failover"][-1]
+    assert fo["trace_id"] == trace and fo["request_id"] == rid
+    # both replicas adopted the SAME trace id at admission
+    adopts = [
+        e for e in events
+        if e["kind"] == "trace_adopt" and e.get("trace_id") == trace
+    ]
+    assert {e.get("replica") for e in adopts} == {victim, sibling}
+    assert any(e.get("resumed") for e in adopts)
+
+
+def test_router_fleet_metrics_reexport(fleet):
+    """Router /metrics = its own families + every replica's series
+    re-exported with a replica label, plus the fleet aggregates."""
+    state = fleet.router.state
+    # scrape synchronously (the background sampler also does this, but
+    # the test must not depend on its timing)
+    ok = state.fleet.scrape_once()
+    assert ok == {"r0": True, "r1": True}
+    m = _scrape(fleet.router_url)
+    # replica-labelled re-export of a replica-side family
+    assert re.search(
+        r'dllama_http_requests_total\{replica="r0",', m
+    ), m[:2000]
+    # fleet aggregates are present and sane
+    assert _metric(m, "dllama_fleet_replicas", '{state="healthy"}') == 2.0
+    assert _metric(m, "dllama_fleet_goodput_tokens_per_s") >= 0.0
+    skew = _metric(m, "dllama_fleet_tpot_skew_ms")
+    assert skew >= 0.0
+    assert _metric(
+        m, "dllama_fleet_scrapes_total", '{outcome="ok"}'
+    ) >= 2.0
+    # per-replica TPOT p50 gauges exist for both replicas
+    for name in ("r0", "r1"):
+        assert re.search(
+            r"dllama_fleet_replica_tpot_p50_ms\{replica=\"%s\"\}" % name,
+            m,
+        )
+    # the router's series endpoint serves the fleet store + monitor
+    idx = _get(fleet.router_url + "/v1/debug/series")
+    assert "dllama_fleet_goodput_tokens_per_s" in idx["names"]
+    assert idx["anomaly"]["degraded"] is False
+    q = _get(
+        fleet.router_url
+        + "/v1/debug/series?name=dllama_fleet_goodput_tokens_per_s"
+        "&window=600"
+    )
+    assert q["points"], q
+    # the fleet dashboard serves the self-contained page
+    with urllib.request.urlopen(
+        fleet.router_url + "/dashboard", timeout=30
+    ) as r:
+        page = r.read().decode()
+    assert "dllama_fleet_goodput_tokens_per_s" in page
 
 
 def test_fleet_chaos_every_stream_completes(fleet):
