@@ -21,6 +21,7 @@ batch-N decode to the run).
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -1162,6 +1163,160 @@ def _serving_smoke(n_clients: int) -> dict:
         ),
     }
 
+    # predictive admission under overload (ISSUE 20): the same 4x
+    # sustained-overload wave — mixed priorities, half the requests
+    # carrying a deadline the machine can honor and half a TTFT budget
+    # it provably cannot — against a predictive-on server and a
+    # queue-depth-only baseline. The baseline admits everything and
+    # burns lane time generating tokens for requests that already blew
+    # their budget; the predictor rejects those up front (429 +
+    # predicted Retry-After) so the same lanes finish the feasible work
+    # sooner. Goodput counts ONLY tokens from requests that met their
+    # own deadline, so wasted capacity shows up as the gap.
+    def overload_server(predict: bool):
+        eng = InferenceEngine(
+            model_path, tokenizer=tok, batch_size=2, temperature=0.0
+        )
+        srv_ = serve(
+            eng, tok, host="127.0.0.1", port=0, admission_chunk=32,
+            slo_ttft_ms=600000.0, slo_tpot_ms=60000.0,
+            admission_predict=predict,
+        )
+        threading.Thread(  # dlint: disable=thread-hygiene — serve_forever exits at srv_.shutdown() below; no handle needed
+            target=srv_.serve_forever, daemon=True,
+            name=f"dllama-bench-http-ovl-{'pred' if predict else 'base'}",
+        ).start()
+        return srv_
+
+    def overload_round(srv_) -> dict:
+        port_ = srv_.server_address[1]
+        # warm: compile prefill/decode so both configs time steady state
+        ovl_warm = http.client.HTTPConnection("127.0.0.1", port_, timeout=300)
+        ovl_warm.request(
+            "POST", "/v1/chat/completions",
+            json.dumps({
+                "messages": [{"role": "user", "content": "warm"}],
+                "max_tokens": 4, "temperature": 0.0,
+            }),
+            {"Content-Type": "application/json"},
+        )
+        ovl_warm.getresponse().read()
+        ovl_warm.close()
+        pre = scrape_port(port_)
+        outs: dict = {}
+
+        def one(i: int) -> None:
+            feasible = i % 2 == 0
+            req = {
+                "messages": [
+                    {"role": "user", "content": f"overload stream {i}"}
+                ],
+                "max_tokens": 24, "temperature": 0.0,
+                "priority": ("high", "normal", "low")[i % 3],
+            }
+            if feasible:
+                req["deadline_ms"] = 300000.0
+            else:
+                req["ttft_budget_ms"] = 1.0  # unmeetable: < one chunk
+            conn = http.client.HTTPConnection("127.0.0.1", port_, timeout=300)
+            t0_ = time.perf_counter()
+            conn.request(
+                "POST", "/v1/chat/completions", json.dumps(req),
+                {"Content-Type": "application/json"},
+            )
+            r = conn.getresponse()
+            data = json.loads(r.read().decode("utf-8"))
+            wall_ = time.perf_counter() - t0_
+            conn.close()
+            n_tok = (
+                data.get("usage", {}).get("completion_tokens", 0)
+                if r.status == 200 else 0
+            )
+            outs[i] = (r.status, feasible, n_tok, wall_)
+
+        n_over = 16  # 8 concurrent per wave on 2 lanes = 4x overload
+        t0_ = time.perf_counter()
+        for wave in range(2):
+            ths = [
+                threading.Thread(
+                    target=one, args=(wave * 8 + j,), daemon=True,
+                    name=f"dllama-bench-ovl-{wave * 8 + j}",
+                )
+                for j in range(8)
+            ]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+        wall = time.perf_counter() - t0_
+        post = scrape_port(port_)
+
+        def labeled_delta(name: str, labels: str) -> int:
+            pat = rf"^{re.escape(name + labels)} ([0-9.eE+-]+)$"
+            pre_m = re.search(pat, pre, re.M)
+            post_m = re.search(pat, post, re.M)
+            return int(
+                (float(post_m.group(1)) if post_m else 0.0)
+                - (float(pre_m.group(1)) if pre_m else 0.0)
+            )
+
+        # a request's tokens are goodput only if it met its OWN deadline:
+        # the tight-budget half can never meet 1 ms TTFT, so its tokens
+        # are pure waste wherever they were generated
+        good_tokens = sum(n for st, feas, n, w in outs.values()
+                          if st == 200 and feas)
+        c_adm = http.client.HTTPConnection("127.0.0.1", port_, timeout=30)
+        c_adm.request("GET", "/v1/debug/admission")
+        adm = json.loads(c_adm.getresponse().read().decode("utf-8"))
+        c_adm.close()
+        return {
+            "n_requests": n_over,
+            "completed": sum(1 for st, _, _, _ in outs.values() if st == 200),
+            "rejected": sum(1 for st, _, _, _ in outs.values() if st != 200),
+            "goodput_tok_s": round(good_tokens / wall, 2),
+            "wall_s": round(wall, 3),
+            "shed_by_reason": {
+                "infeasible": labeled_delta(
+                    "dllama_admission_rejected_total",
+                    '{reason="infeasible"}',
+                ),
+                "queue_full": labeled_delta(
+                    "dllama_requests_shed_total", '{reason="queue_full"}'
+                ),
+            },
+            "prediction_error_ms": adm.get("prediction_error"),
+        }
+
+    srv_pred = overload_server(predict=True)
+    ovl_pred = overload_round(srv_pred)
+    srv_pred.shutdown()
+    srv_base = overload_server(predict=False)
+    ovl_base = overload_round(srv_base)
+    srv_base.shutdown()
+    overload = {
+        "overload_factor": 4,
+        "predictive": ovl_pred,
+        "baseline": ovl_base,
+        "goodput_tok_s": ovl_pred["goodput_tok_s"],
+        "goodput_tok_s_baseline": ovl_base["goodput_tok_s"],
+    }
+    # CI gates (ISSUE 20 acceptance): predictive goodput must not lose
+    # to the queue-depth-only baseline on the same overload wave, every
+    # infeasible request must be refused before admission, and the
+    # predictor must be scoring itself with finite error percentiles
+    assert ovl_pred["goodput_tok_s"] >= ovl_base["goodput_tok_s"], (
+        f"predictive goodput {ovl_pred['goodput_tok_s']} < baseline "
+        f"{ovl_base['goodput_tok_s']}"
+    )
+    assert ovl_pred["shed_by_reason"]["infeasible"] == 8, overload
+    perr = ovl_pred["prediction_error_ms"] or {}
+    assert (
+        perr.get("p50_ms") is not None
+        and math.isfinite(perr["p50_ms"])
+        and perr.get("p95_ms") is not None
+        and math.isfinite(perr["p95_ms"])
+    ), overload
+
     # replica fleet (ISSUE 17): 2-replica in-process topology behind the
     # prefix-affinity router. Three rounds on a shared-prefix workload:
     # random routing vs affinity routing (each round uses its OWN shared
@@ -1387,6 +1542,7 @@ def _serving_smoke(n_clients: int) -> dict:
         "speculation_nl": speculation_nl,
         "resilience": resilience,
         "oversubscription": oversubscription,
+        "overload": overload,
         "fleet": fleet_block,
         "slo": slo,
         "timeline": timeline,

@@ -220,6 +220,28 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                         "wait for a lane; priority 'low' sheds at half "
                         "this, 'high' at double (default 0 = unbounded; "
                         "env DLLAMA_MAX_QUEUE_DEPTH)")
+    p.add_argument("--admission-predict", action="store_true", default=None,
+                   help="predictive admission control: estimate TTFT/TPOT "
+                        "per request from the cost model + occupancy, "
+                        "reject-or-queue infeasible deadline-hinted work "
+                        "before admitting it, and order admission EDF-style "
+                        "(runtime/admission.py; env "
+                        "DLLAMA_ADMISSION_PREDICT; default off)")
+    p.add_argument("--admission-max-wait-ms", type=int, default=None,
+                   help="cap on the predicted queue-drain time advertised "
+                        "via Retry-After on shed responses (default 30000; "
+                        "env DLLAMA_ADMISSION_MAX_WAIT_MS)")
+    p.add_argument("--deadline-default-ms", type=int, default=None,
+                   help="effective deadline assigned to requests with no "
+                        "deadline_ms/ttft_budget_ms hint, anchoring the "
+                        "EDF admission order (default 600000; env "
+                        "DLLAMA_DEADLINE_DEFAULT_MS)")
+    p.add_argument("--deadline-priority-step-ms", type=int, default=None,
+                   help="deadline offset per priority rung for unhinted "
+                        "requests: high = -1 step, low = +1 step, so the "
+                        "PR 12 priority ladder survives as EDF offsets "
+                        "(default 60000; env "
+                        "DLLAMA_DEADLINE_PRIORITY_STEP_MS)")
     p.add_argument("--sync-measure", default="auto", choices=["auto", "off"],
                    help="measure per-step collective time via a short "
                    "profiled re-run (multi-device greedy runs only; 'off' "

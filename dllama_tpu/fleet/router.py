@@ -146,6 +146,20 @@ class _StreamDeath(Exception):
     in-stream retryable error frame. The relay fails over."""
 
 
+def _retry_after_s(value, default: int = 2) -> int:
+    """Parse an upstream ``Retry-After`` header value (delta-seconds
+    form) into a positive int; ``default`` on absent/malformed input.
+    HTTP-date form is not produced by the replicas, so it falls through
+    to the default rather than being parsed."""
+    if value is None:
+        return default
+    try:
+        s = int(float(value))
+    except (TypeError, ValueError):
+        return default
+    return s if s > 0 else default
+
+
 class RouterState:
     """Shared router state: registry + ring + tokenizer + metrics."""
 
@@ -189,6 +203,13 @@ class RouterState:
             stops[0] if stops else "",
         )
         self.ring = HashRing(registry.names)
+        # predicted-wait bookkeeping (ISSUE 20): a replica that sheds
+        # with Retry-After is predicting its own queue-drain time, so
+        # the router remembers "busy until" per replica and demotes
+        # still-backing-off siblings in the spill order instead of
+        # hammering them with requests they already said they'd shed
+        self._shed_until: dict[str, float] = {}
+        self._shed_lock = threading.Lock()
         # deterministic per-request RNG stream for routing="random" (the
         # bench's affinity-off baseline): string seeding is stable across
         # processes, unlike hash()-seeded tuples
@@ -289,6 +310,48 @@ class RouterState:
                     candidates=list(plan.candidates),
                 )
         return plan
+
+    # -------------------------------------------------- predicted wait
+
+    def note_shed(self, name: str, retry_after) -> None:
+        """A replica shed with the given ``Retry-After`` (header string,
+        int, or None): remember its self-predicted busy-until time."""
+        s = _retry_after_s(retry_after)
+        with self._shed_lock:
+            self._shed_until[name] = time.monotonic() + s
+
+    def shed_wait_s(self, name: str) -> float:
+        """Seconds this replica predicted it stays saturated (0 when it
+        never shed or the backoff already expired)."""
+        with self._shed_lock:
+            until = self._shed_until.get(name)
+        if until is None:
+            return 0.0
+        return max(0.0, until - time.monotonic())
+
+    def order_by_backoff(self, candidates: list[str]) -> list[str]:
+        """Predicted-wait-aware spill order: candidates whose shed
+        backoff expired keep their (affinity) order and come first;
+        replicas still inside a self-predicted busy window are demoted
+        to the tail, soonest-free first. Nothing is dropped — when the
+        whole fleet is backing off, the least-backed-off replica is
+        still tried (it may have drained early)."""
+        waits = [(self.shed_wait_s(n), i, n) for i, n in enumerate(candidates)]
+        free = [n for w, _, n in waits if w <= 0.0]
+        busy = [n for w, i, n in sorted(waits) if w > 0.0]
+        return free + busy
+
+    def min_shed_wait_s(self) -> int | None:
+        """Smallest non-expired predicted wait across the fleet — the
+        honest Retry-After for an all-replicas-shed 503 (None when no
+        replica is inside a backoff window)."""
+        waits = [
+            w for w in (self.shed_wait_s(n) for n in self.registry.names)
+            if w > 0.0
+        ]
+        if not waits:
+            return None
+        return max(1, int(-(-min(waits) // 1)))
 
     # ------------------------------------------------------------- fleet
 
@@ -602,20 +665,22 @@ def make_router_handler(state: RouterState):
                     candidates=list(plan.candidates),
                 )
             state.ledger.open(rid, trace)
+            plan.candidates = state.order_by_backoff(plan.candidates)
             if not plan.candidates:
                 state.m_requests.labels(
                     replica="none", outcome="unavailable"
                 ).inc()
+                ra = state.min_shed_wait_s() or 2
                 self._json(
                     {
                         "error": {
                             "message": "no replica available",
                             "retryable": True,
-                            "retry_after_s": 2,
+                            "retry_after_s": ra,
                         }
                     },
                     503,
-                    retry_after=2,
+                    retry_after=ra,
                 )
                 return
             if body.get("stream"):
@@ -668,7 +733,8 @@ def make_router_handler(state: RouterState):
             """Non-stream requests: whole-request retry on the next
             candidate (greedy/seeded requests reproduce; an unseeded
             sampled request re-samples — documented in docs/fleet.md)."""
-            headers = {"x-dllama-trace": trace, "x-dllama-request": rid}
+            headers = self._fleet_headers(rid, trace)
+            shed_ra = None  # smallest upstream Retry-After seen
             for name in plan.candidates:
                 relay_h = state.spans.begin(
                     "relay", component="router", request_id=rid,
@@ -699,6 +765,9 @@ def make_router_handler(state: RouterState):
                     continue
                 _, status, data, retry_after = res
                 if status in (429, 503):
+                    state.note_shed(name, retry_after)
+                    ra = _retry_after_s(retry_after)
+                    shed_ra = ra if shed_ra is None else min(shed_ra, ra)
                     state.m_spills.labels(reason="shed").inc()
                     state.m_requests.labels(
                         replica=name, outcome="shed"
@@ -730,19 +799,34 @@ def make_router_handler(state: RouterState):
             state.m_requests.labels(
                 replica="none", outcome="unavailable"
             ).inc()
+            # propagate the fleet's own prediction: the smallest upstream
+            # Retry-After seen this request (replicas derive it from
+            # predicted queue-drain time, ISSUE 20), not a constant
+            ra = shed_ra if shed_ra is not None else 2
             self._json(
                 {
                     "error": {
                         "message": "all replicas refused or shed",
                         "retryable": True,
-                        "retry_after_s": 2,
+                        "retry_after_s": ra,
                     }
                 },
                 503,
-                retry_after=2,
+                retry_after=ra,
             )
 
         # -------------------------------------------------- stream relay
+
+        def _fleet_headers(self, rid: str, trace: str) -> dict:
+            """Relay headers: the trace-propagation pair plus the
+            client's deadline hint (``x-dllama-deadline-ms``), forwarded
+            verbatim so replica-side predictive admission sees the same
+            budget on the first issue AND on failover re-issues."""
+            headers = {"x-dllama-trace": trace, "x-dllama-request": rid}
+            ddl = self.headers.get("x-dllama-deadline-ms")
+            if ddl:
+                headers["x-dllama-deadline-ms"] = ddl
+            return headers
 
         def _open_upstream(
             self, base_url: str, req_body: dict,
@@ -900,7 +984,8 @@ def make_router_handler(state: RouterState):
             span is the client-visible gap, and its duration feeds
             ``dllama_router_failover_gap_seconds``."""
             book: dict = {"emitted": [], "exact": "", "relayed": ""}
-            headers = {"x-dllama-trace": trace, "x-dllama-request": rid}
+            headers = self._fleet_headers(rid, trace)
+            shed_ra = None  # smallest upstream Retry-After seen
             max_tokens = int(body.get("max_tokens", -1) or -1)
             started = False     # SSE headers sent to OUR client
             first_replica = None
@@ -946,6 +1031,11 @@ def make_router_handler(state: RouterState):
                     if kind == "response":
                         _, status, data, _ra = res
                         if status in (429, 503):
+                            state.note_shed(name, _ra)
+                            ra = _retry_after_s(_ra)
+                            shed_ra = (
+                                ra if shed_ra is None else min(shed_ra, ra)
+                            )
                             state.m_spills.labels(reason="shed").inc()
                             state.m_requests.labels(
                                 replica=name, outcome="shed"
@@ -1090,16 +1180,17 @@ def make_router_handler(state: RouterState):
                     replica="none", outcome="unavailable"
                 ).inc()
                 if not started:
+                    ra = shed_ra if shed_ra is not None else 2
                     self._json(
                         {
                             "error": {
                                 "message": "all replicas refused or shed",
                                 "retryable": True,
-                                "retry_after_s": 2,
+                                "retry_after_s": ra,
                             }
                         },
                         503,
-                        retry_after=2,
+                        retry_after=ra,
                     )
                     return
                 self._client_chunk(

@@ -345,6 +345,8 @@ DEFAULT_SIGNAL_SERIES = (
     "dllama_tpot_seconds_count",
     "dllama_kv_pages_free",
     'dllama_slo_goodput_tokens_per_s{window="1m"}',
+    'dllama_admission_predict_error_ms_sum{signal="ttft"}',
+    'dllama_admission_predict_error_ms_count{signal="ttft"}',
 )
 
 
@@ -362,7 +364,12 @@ def build_default_rules(store: SeriesStore) -> list[AnomalyRule]:
       faster than its baseline churn (a retain leak or runaway fanout
       exhausts the pool long before allocation actually fails);
     * ``goodput`` — the 1-minute SLO-met tokens/s dropping far below its
-      baseline while the engine is supposed to be under load.
+      baseline while the engine is supposed to be under load;
+    * ``predict_error`` — the predictive admission controller's mean
+      TTFT forecast error blowing up over its baseline: the EWMA
+      self-calibration (runtime/admission.py) should keep this bounded,
+      so a sustained spike means the predictor is steering admission /
+      EDF ordering / preemption with a broken model of the machine.
     """
     return [
         AnomalyRule(
@@ -416,5 +423,18 @@ def build_default_rules(store: SeriesStore) -> list[AnomalyRule]:
             min_mean=1.0,
             rel_frac=0.5,
             min_samples=60,
+        ),
+        AnomalyRule(
+            "predict_error",
+            _per_event_rate(
+                store,
+                'dllama_admission_predict_error_ms_sum{signal="ttft"}',
+                'dllama_admission_predict_error_ms_count{signal="ttft"}',
+            ),
+            direction="high",
+            z_threshold=4.0,
+            min_abs=50.0,
+            rel_frac=2.0,
+            min_samples=30,
         ),
     ]

@@ -97,6 +97,24 @@ def roofline_fraction(
     return (bytes_accessed / step_seconds) / peak_bytes_per_s
 
 
+def analytic_step_seconds(
+    bytes_accessed: float | None, peak_bytes_per_s: float | None
+) -> float | None:
+    """Bandwidth-bound lower bound on one dispatch's wall time: the
+    program's cost-analysis bytes pushed through the chip's HBM peak.
+    The LoadPredictor's cold-start floor (runtime/admission.py) before
+    any measured step percentiles exist; None when the cost or the peak
+    is unknown (CPU backend, lazily jitted program)."""
+    if (
+        bytes_accessed is None
+        or bytes_accessed <= 0
+        or peak_bytes_per_s is None
+        or peak_bytes_per_s <= 0
+    ):
+        return None
+    return float(bytes_accessed) / float(peak_bytes_per_s)
+
+
 def weight_bytes_per_token(
     h: "LlmHeader", weight_format: str, i8_group: int = 512
 ) -> int:

@@ -91,6 +91,7 @@ const DEFAULTS = [
   "dllama_decode_stall_seconds_p99",
   "dllama_kv_pages_free",
   "dllama_spec_acceptance_rate",
+  'dllama_admission_predict_error_ms_p50{signal="ttft"}',
 ];
 const FLEET_DEFAULTS = [
   "dllama_fleet_goodput_tokens_per_s",

@@ -135,15 +135,22 @@ class SloTracker:
         tpot_s: float | None,
         queue_wait_s: float | None = None,
         n_tokens: int = 0,
+        deadline_ms: float | None = None,
+        total_s: float | None = None,
     ) -> bool:
         """One finished request; returns whether it met its targets. A
         missing sample (e.g. TTFT on a zero-token stream) only violates a
-        target that is actually configured."""
+        target that is actually configured. A per-request ``deadline_ms``
+        hint (ISSUE 20 predictive admission) is an additional target for
+        THIS request only: blowing it makes the request SLO-unmet (its
+        tokens drop out of goodput) even when the global targets pass."""
         met = True
         if self.ttft_target_ms is not None:
             met = ttft_s is not None and ttft_s * 1000.0 <= self.ttft_target_ms
         if met and self.tpot_target_ms is not None and tpot_s is not None:
             met = tpot_s * 1000.0 <= self.tpot_target_ms
+        if met and deadline_ms is not None and total_s is not None:
+            met = total_s * 1000.0 <= deadline_ms
         with self._lock:
             self._requests.append(
                 (self._clock(), ttft_s, tpot_s, queue_wait_s,
@@ -151,7 +158,9 @@ class SloTracker:
             )
         return met
 
-    def observe_span(self, span: object) -> bool | None:
+    def observe_span(
+        self, span: object, deadline_ms: float | None = None
+    ) -> bool | None:
         """Record a finished :class:`~dllama_tpu.obs.trace.RequestSpan`.
         Only clean finishes (stop/length) count toward attainment —
         a cancelled stream says nothing about the service's latency."""
@@ -162,7 +171,8 @@ class SloTracker:
         if (span.total_s is not None and span.ttft_s is not None and n > 1):
             tpot_s = (span.total_s - span.ttft_s) / (n - 1)
         return self.observe_request(
-            span.ttft_s, tpot_s, span.queue_wait_s, n_tokens=n
+            span.ttft_s, tpot_s, span.queue_wait_s, n_tokens=n,
+            deadline_ms=deadline_ms, total_s=span.total_s,
         )
 
     def note_tokens(self, n: int = 1) -> None:

@@ -81,6 +81,13 @@ from ..tokenizer import (
     EosResult,
     Tokenizer,
 )
+from .admission import (
+    LoadPredictor,
+    OccupancySnapshot,
+    effective_deadline_ms,
+    resolve_admission_knobs,
+    resolve_deadline_knobs,
+)
 from .engine import InferenceEngine
 from .faults import get_fault_plane, set_fault_plane
 from .spec import (
@@ -185,6 +192,18 @@ class InferenceParams:
     # JSONL carry fleet-level identity. None outside a fleet.
     trace_id: str | None = None
     request_id: str | None = None
+    # predictive admission (ISSUE 20): optional per-request latency
+    # budgets. deadline_ms bounds the WHOLE completion, ttft_budget_ms
+    # just the first token; either makes the request "hinted" — the
+    # predictive controller may infeasible-reject it up front and EDF
+    # orders it by its effective deadline. The fleet router forwards
+    # x-dllama-deadline-ms so a budget survives relays and failovers.
+    deadline_ms: float | None = None
+    ttft_budget_ms: float | None = None
+
+    @property
+    def deadline_hinted(self) -> bool:
+        return self.deadline_ms is not None or self.ttft_budget_ms is not None
 
 
 class LaneJob:
@@ -208,6 +227,15 @@ class LaneJob:
         # timeline queue span (obs/spans.py): begun at submit on the
         # handler thread, ended by the scheduler when admission starts
         self.queue_span = None
+        # predictive admission (ISSUE 20): the EDF sort key (set at
+        # submit from the deadline hints / priority offsets) and the
+        # forecast recorded at admission start for error tracking —
+        # _finish compares it against the observed TTFT/TPOT and folds
+        # the ratio back into the LoadPredictor's EWMA correction
+        self.edf_deadline_ms: float | None = None
+        self.submit_t: float | None = None
+        self.predicted_ttft_ms: float | None = None
+        self.predicted_tpot_ms: float | None = None
 
 
 @dataclass
@@ -489,6 +517,18 @@ class LaneScheduler:
 
     def submit(self, params: InferenceParams) -> LaneJob:
         job = LaneJob(params)
+        # EDF sort key (ISSUE 20): hints win; otherwise the priority
+        # ladder becomes deadline offsets, so with no hints the pick
+        # order is (priority class, arrival) — the PR 12 contract
+        job.submit_t = self._clock()
+        job.edf_deadline_ms = effective_deadline_ms(
+            job.submit_t * 1000.0,
+            priority=params.priority,
+            deadline_ms=params.deadline_ms,
+            ttft_budget_ms=params.ttft_budget_ms,
+            default_ms=self.state.deadline_default_ms,
+            priority_step_ms=self.state.deadline_priority_step_ms,
+        )
         # adopt router-propagated identity when present: the span's
         # request id (and thus every timeline span keyed on it) is the
         # FLEET request id, so a failover's two half-timelines share it
@@ -511,6 +551,34 @@ class LaneScheduler:
     def _set_lane_gauge(self) -> None:
         self.state.m_lanes_active.set(
             sum(1 for ls in self.lanes if ls is not None)
+        )
+
+    def occupancy(self) -> OccupancySnapshot:
+        """Dynamic load snapshot for the LoadPredictor: the engine's
+        occupancy() contributes the static shape, this overlays active
+        lanes / admitting chunks / parked streams / queue depth. Takes
+        the scheduler cv briefly so the queue-depth read is consistent
+        with the lane fields; callable from any thread (the scheduler
+        itself only calls it outside its cv block)."""
+        chunk = max(1, self.admission_chunk)
+        with self.cv:
+            active = sum(1 for ls in self.lanes if ls is not None)
+            admitting = list(self.admitting.values())
+            queue_depth = len(self.pending)
+            parked = self._n_parked
+        chunks_left = 0
+        for adm in admitting:
+            todo = max(0, adm.prompt_end - adm.cursor)
+            chunks_left += max(1, -(-todo // chunk))
+        return OccupancySnapshot(
+            lanes_total=len(self.lanes),
+            active_lanes=active,
+            parked=parked,
+            admitting=len(admitting),
+            admitting_chunks=chunks_left,
+            queue_depth=queue_depth,
+            block_size=self.block_size,
+            admission_chunk=chunk,
         )
 
     # -- failure classification + recovery (PR 12) -------------------------
@@ -719,7 +787,30 @@ class LaneScheduler:
                     if self.lanes[i] is None and i not in self.admitting
                 ]
                 while self.pending and free:
-                    job = self.pending.pop(0)
+                    # EDF pick (ISSUE 20, predictive mode): earliest
+                    # effective deadline first, queue order breaking
+                    # ties — priorityless no-hint traffic degenerates to
+                    # FIFO. Predictive off keeps the PR 12 pop(0).
+                    # Objects without an edf key (tests inject opaque
+                    # queue fillers) sort last instead of crashing.
+                    idx = 0
+                    if self.state.admission_predict:
+                        idx = min(
+                            range(len(self.pending)),
+                            key=lambda i: (
+                                getattr(
+                                    self.pending[i], "edf_deadline_ms",
+                                    None,
+                                )
+                                if getattr(
+                                    self.pending[i], "edf_deadline_ms",
+                                    None,
+                                ) is not None
+                                else float("inf"),
+                                i,
+                            ),
+                        )
+                    job = self.pending.pop(idx)
                     # any lane serves any conversation (the prefix store is
                     # the shared pool, not lane KV): take the
                     # least-recently-used free lane
@@ -749,6 +840,12 @@ class LaneScheduler:
             # the most-progressed lane (publish + drop page list); it
             # frees this tick and the queued request admits next tick
             self._maybe_park(n_pending)
+            # deadline preemption (ISSUE 20): park an over-budget /
+            # deadline-blown lower-priority stream when that flips a
+            # feasible hinted request from "blows its budget waiting"
+            # to "meets SLO" — reuses the PR 16 park/resume contract,
+            # so the victim's stream stays byte-identical on resume
+            self._maybe_preempt(n_pending)
             # stall-free admission: at most ONE bounded prefill chunk per
             # tick, then a decode block for every active lane — the worst
             # case inter-token gap is one chunk + one block, and two
@@ -838,6 +935,94 @@ class LaneScheduler:
                 victim, best = lane, self._progress[lane]
         if victim >= 0:
             self._park_stream(victim)
+
+    def _maybe_preempt(self, n_pending: int) -> None:
+        """Deadline preemption (ISSUE 20, predictive mode only): when
+        the EDF head is a HINTED request that blows its budget if it
+        waits for natural lane turnover, but would meet it on a lane
+        freed right now, park ONE active lower-priority (or already
+        deadline-blown) stream through the PR 16 contract. The victim
+        requeues with its later effective deadline, so EDF resumes it
+        after the deadline traffic — paused, never restarted, its
+        token stream byte-identical. Preemption never fires when the
+        head is infeasible either way: burning a victim cannot save
+        it."""
+        st = self.state
+        if (
+            not st.admission_predict
+            or st.predictor is None
+            or self.kv is None
+            or n_pending <= 0
+            or self.admitting
+        ):
+            return
+        if any(
+            self.lanes[i] is None and i not in self.admitting
+            for i in range(len(self.lanes))
+        ):
+            return
+        head, head_key = None, None
+        with self.cv:
+            pending = list(self.pending)
+        for j in pending:
+            key = getattr(j, "edf_deadline_ms", None)
+            if key is None:
+                continue
+            if head_key is None or key < head_key:
+                head, head_key = j, key
+        if head is None or not head.params.deadline_hinted:
+            return
+        now_ms = self._clock() * 1000.0
+        remaining_ms = head_key - now_ms
+        if remaining_ms <= 0:
+            return
+        n_tok = head.n_prompt_tokens or st.estimate_prompt_tokens(
+            head.params
+        )
+        occ = self.occupancy()
+        wait_pred = st.predictor.predict(n_tok, occ)
+        if wait_pred.ttft_ms <= remaining_ms:
+            return  # feasible by waiting — no victim needed
+        # forecast against a freed lane: zero queue wait, admission
+        # starts next tick
+        occ_freed = self.occupancy()
+        occ_freed.queue_depth = 0
+        occ_freed.active_lanes = max(0, occ_freed.active_lanes - 1)
+        now_pred = st.predictor.predict(n_tok, occ_freed)
+        if now_pred.ttft_ms > remaining_ms:
+            return  # infeasible either way
+        prio_rank = {"low": 0, "normal": 1, "high": 2}
+        head_rank = prio_rank.get(head.params.priority, 1)
+        victim, v_score, v_blown = -1, None, False
+        for lane, ls in enumerate(self.lanes):
+            if ls is None or ls.job.cancelled:
+                continue
+            # same no-thrash floor as _maybe_park: at least one full
+            # block of progress since (re)admission
+            if self._progress[lane] <= self.block_size - 1:
+                continue
+            r = prio_rank.get(ls.job.params.priority, 1)
+            vkey = getattr(ls.job, "edf_deadline_ms", None)
+            vkey = vkey if vkey is not None else float("inf")
+            blown = vkey < now_ms
+            if r >= head_rank and not blown:
+                continue  # only lower-priority or deadline-blown streams
+            score = (r, -vkey)
+            if v_score is None or score < v_score:
+                victim, v_score, v_blown = lane, score, blown
+        if victim < 0:
+            return
+        reason = "deadline_blown" if v_blown else "priority"
+        rid = self.lanes[victim].job.span.request_id
+        self._park_stream(victim)
+        st.m_preemptions.labels(reason=reason).inc()
+        st.recorder.record(
+            "stream_preempt", lane=victim, reason=reason,
+            victim_request=rid,
+            head_request=head.span.request_id,
+            head_remaining_ms=round(remaining_ms, 3),
+            predicted_ttft_ms=round(now_pred.ttft_ms, 3),
+        )
 
     def _park_stream(self, lane: int) -> None:
         """Evict an active stream from its lane to make room for a
@@ -955,6 +1140,20 @@ class LaneScheduler:
             )
             state.m_queue_wait.observe(qw)
             state.m_admissions.inc()
+            # admission-time forecast (ISSUE 20): the queue wait is now
+            # known exactly and the radix match says how much prefill
+            # is skipped — record the prediction _finish scores against
+            # the observed TTFT/TPOT to self-calibrate the predictor
+            if state.predictor is not None and qw is not None:
+                fc = state.predictor.predict(
+                    len(tokens), self.occupancy(),
+                    matched_tokens=start_pos,
+                )
+                job.predicted_ttft_ms = (
+                    qw * 1000.0 + fc.ttft_ms - fc.queue_wait_ms
+                )
+                job.predicted_tpot_ms = fc.tpot_ms
+                state.m_predicted_ttft.observe(job.predicted_ttft_ms)
             seq_len = self.engine.header.seq_len
             prompt_end = len(tokens) - 1
             if prompt_end >= seq_len:
@@ -1297,7 +1496,10 @@ class LaneScheduler:
             self.state.m_finished.labels(reason=reason).inc()
             if reason == "cancelled":
                 self.state.m_cancellations.inc()
-        self.state.slo.observe_span(ls.job.span)
+        self.state.slo.observe_span(
+            ls.job.span, deadline_ms=ls.job.params.deadline_ms
+        )
+        self._score_prediction(ls.job, reason)
         self.state.spans.maybe_flush()
         ls.job.events.put(("done", reason))
         self.state.recorder.record(
@@ -1310,6 +1512,42 @@ class LaneScheduler:
         self._set_lane_gauge()
         with self.cv:
             self.cv.notify()
+
+    def _score_prediction(self, job: LaneJob, reason: str) -> None:
+        """Estimated-vs-observed TTFT/TPOT for one finished request
+        (ISSUE 20): the absolute error feeds the first-class error
+        histogram and the EWMA correction folds the observed/predicted
+        ratio back into the LoadPredictor. Only clean finishes score —
+        a cancelled stream's latency says nothing about the model."""
+        st = self.state
+        pred = st.predictor
+        if (
+            pred is None
+            or job.predicted_ttft_ms is None
+            or reason not in ("stop", "length")
+        ):
+            return
+        span = job.span
+        ttft_s = getattr(span, "ttft_s", None)
+        if ttft_s is not None and ttft_s > 0:
+            obs_ms = ttft_s * 1000.0
+            err_ms = abs(obs_ms - job.predicted_ttft_ms)
+            st.m_predict_error.labels(signal="ttft").observe(err_ms)
+            st.note_predict_error(err_ms)
+            pred.observe_ttft(job.predicted_ttft_ms, obs_ms)
+        total_s = getattr(span, "total_s", None)
+        n = job.n_completion
+        if (
+            job.predicted_tpot_ms is not None
+            and total_s is not None
+            and ttft_s is not None
+            and n > 1
+        ):
+            obs_tpot_ms = (total_s - ttft_s) / (n - 1) * 1000.0
+            st.m_predict_error.labels(signal="tpot").observe(
+                abs(obs_tpot_ms - job.predicted_tpot_ms)
+            )
+            pred.observe_tpot(job.predicted_tpot_ms, obs_tpot_ms)
 
     def _consume_token(self, lane: int, t: int) -> bool:
         """Advance one lane by one generated token — lane state, history,
@@ -1709,6 +1947,10 @@ class ApiState:
         retry_backoff_ms: int = 5,
         max_queue_depth: int = 0,
         replica_id: str | None = None,
+        admission_predict: bool = False,
+        admission_max_wait_ms: int = 30_000,
+        deadline_default_ms: int = 600_000,
+        deadline_priority_step_ms: int = 60_000,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
@@ -1723,6 +1965,23 @@ class ApiState:
         self.retry_max = int(retry_max)
         self.retry_backoff_ms = int(retry_backoff_ms)
         self.max_queue_depth = int(max_queue_depth)
+        # predictive admission (ISSUE 20, resolve_admission_knobs /
+        # resolve_deadline_knobs): predict gates the whole controller
+        # (infeasible-reject, EDF ordering, deadline preemption); the
+        # deadline knobs shape the synthetic effective deadlines that
+        # keep PR 12 priority semantics when no hints are given. The
+        # LoadPredictor itself also backs the derived Retry-After on
+        # every shed path, predictive mode on or off.
+        self.admission_predict = bool(admission_predict)
+        self.admission_max_wait_ms = int(admission_max_wait_ms)
+        self.deadline_default_ms = int(deadline_default_ms)
+        self.deadline_priority_step_ms = int(deadline_priority_step_ms)
+        # bounded ring of recent |predicted - observed| TTFT errors in
+        # ms: /v1/debug/admission reports p50/p95 off it (the bench's
+        # prediction-error readout); appends on the scheduler thread
+        from collections import deque
+
+        self.predict_errors: deque = deque(maxlen=512)
         # graceful drain (POST /v1/drain, SIGTERM): admission stops, the
         # in-flight streams finish, sinks flush, /v1/health says so
         self.draining = False
@@ -1971,6 +2230,45 @@ class ApiState:
             "through the recovery-admission path (near-zero re-prefill "
             "when the parked history published page-aligned).",
         )
+        # predictive admission (ISSUE 20): forecast + error tracking.
+        # Millisecond-scale buckets: TTFT forecasts span ~1ms (warm
+        # prefix, idle engine) to tens of seconds (deep queue).
+        _ms_buckets = (
+            1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+            1000.0, 2000.0, 5000.0, 10000.0, 30000.0, 60000.0,
+        )
+        self.m_predicted_ttft = self.obs.histogram(
+            "dllama_admission_predicted_ttft_ms",
+            "LoadPredictor TTFT forecast recorded at admission (known "
+            "queue wait + cost-model/percentile prefill forecast over "
+            "the radix-matched suffix), in milliseconds.",
+            buckets=_ms_buckets,
+        )
+        self.m_predict_error = self.obs.histogram(
+            "dllama_admission_predict_error_ms",
+            "Absolute estimated-vs-observed error of the admission "
+            "forecast on clean finishes, by signal (ttft / tpot), in "
+            "milliseconds; the EWMA correction factor feeds on the "
+            "same pairs.",
+            labelnames=("signal",),
+            buckets=_ms_buckets,
+        )
+        self.m_admission_rejected = self.obs.counter(
+            "dllama_admission_rejected_total",
+            "Requests rejected by the PREDICTIVE controller before "
+            "touching the queue, by reason (infeasible = the forecast "
+            "says the deadline/TTFT budget cannot be met even if "
+            "admitted now).",
+            labelnames=("reason",),
+        )
+        self.m_preemptions = self.obs.counter(
+            "dllama_preemptions_total",
+            "Active streams parked by deadline preemption so a feasible "
+            "hinted request could meet its SLO, by reason (priority = "
+            "lower-priority victim; deadline_blown = the victim's own "
+            "effective deadline had already passed).",
+            labelnames=("reason",),
+        )
         # request defaults captured once: per-request sampler mutations must
         # not leak into later requests' defaults
         self.default_temperature = engine.temperature
@@ -2004,6 +2302,13 @@ class ApiState:
                 evict_counter=self.m_evictions,
                 native=kv_native,
             )
+        # LoadPredictor (ISSUE 20): always built on the lane path — the
+        # derived Retry-After reads it even with predictive mode off;
+        # the predictive gates (infeasible-reject, EDF, preemption)
+        # additionally consult it when admission_predict is on. Must
+        # exist BEFORE the scheduler thread starts (admission records
+        # forecasts through it).
+        self.predictor = LoadPredictor(engine) if lanes_on else None
         # engine watchdog audits the scheduler loop; it must exist BEFORE
         # the scheduler thread starts (the loop beats it every tick). The
         # decode-stalled threshold scales off the engine's own p99 block
@@ -2134,22 +2439,116 @@ class ApiState:
                 out[name] = q
         return out
 
-    def admission_decision(self, priority: str) -> tuple[str, int] | None:
+    def estimate_prompt_tokens(self, params: InferenceParams) -> int:
+        """Coarse pre-tokenize prompt-length estimate for the PRE-QUEUE
+        feasibility gate (~4 chars/token plus template overhead per
+        message). Deliberately conservative — it assumes zero radix
+        match; the accurate forecast (real token count, real match
+        length) is recorded at admission and the EWMA correction
+        absorbs the residual bias."""
+        if params.resume_tokens is not None:
+            return len(params.resume_tokens)
+        n_chars = sum(len(m.content) for m in params.messages)
+        return max(2, n_chars // 4 + 8 * max(1, len(params.messages)))
+
+    def note_predict_error(self, err_ms: float) -> None:
+        self.predict_errors.append(float(err_ms))
+
+    def predict_error_stats(self) -> dict:
+        """p50/p95 of the recent TTFT prediction errors (ms) — the
+        bench's prediction-error readout via /v1/debug/admission."""
+        errs = sorted(self.predict_errors)
+        n = len(errs)
+        if not n:
+            return {"n": 0, "p50_ms": None, "p95_ms": None}
+        return {
+            "n": n,
+            "p50_ms": round(errs[n // 2], 3),
+            "p95_ms": round(errs[min(n - 1, int(n * 0.95))], 3),
+        }
+
+    def predicted_retry_after(self, floor: int = 1) -> int:
+        """Retry-After derived from the predicted queue-drain time
+        (ISSUE 20) — monotonic in queue depth — replacing the PR 12
+        constants everywhere the structured retryable error is built.
+        Falls back to ``floor`` on the serialized path (no scheduler,
+        no queue to predict)."""
+        sched, pred = self.scheduler, self.predictor
+        if sched is None or pred is None:
+            return floor
+        return max(
+            floor,
+            pred.retry_after_s(
+                sched.occupancy(), self.admission_max_wait_ms
+            ),
+        )
+
+    def admission_snapshot(self) -> dict:
+        """GET /v1/debug/admission: the predictor's calibration state,
+        the live occupancy it forecasts against, and recent prediction
+        error percentiles."""
+        out: dict = {
+            "predictive": self.admission_predict,
+            "max_wait_ms": self.admission_max_wait_ms,
+            "deadline_default_ms": self.deadline_default_ms,
+            "deadline_priority_step_ms": self.deadline_priority_step_ms,
+            "prediction_error": self.predict_error_stats(),
+        }
+        sched, pred = self.scheduler, self.predictor
+        if sched is not None and pred is not None:
+            occ = sched.occupancy()
+            out["occupancy"] = occ.as_dict()
+            out["predictor"] = pred.snapshot()
+            out["retry_after_s"] = pred.retry_after_s(
+                occ, self.admission_max_wait_ms
+            )
+        return out
+
+    def admission_decision(
+        self, priority: str, params: InferenceParams | None = None
+    ) -> tuple[str, int] | None:
         """Load-shedding gate, consulted by the handler BEFORE a request
         touches the scheduler queue. None admits; otherwise returns
         (reason, retry_after_s) and the handler refuses with 429/503 +
         Retry-After. The priority ladder sheds lowest first: a "low"
         request is refused at half the queue threshold and whenever the
-        engine is degraded; "high" rides out twice the threshold."""
+        engine is degraded; "high" rides out twice the threshold.
+
+        Every Retry-After is DERIVED from the predicted queue-drain
+        time (ISSUE 20) instead of the old constants, with the PR 12
+        constants kept as floors. With predictive mode on, a HINTED
+        request whose forecast cannot meet its budget even if admitted
+        now is additionally rejected as ``infeasible`` — unhinted
+        requests never are, so with no hints this gate is exactly the
+        PR 12 ladder."""
         if self.draining:
-            return ("draining", 5)
+            return ("draining", self.predicted_retry_after(floor=5))
         sched = self.scheduler
         if sched is not None and self.max_queue_depth > 0:
             factor = {"low": 0.5, "high": 2.0}.get(priority, 1.0)
             if len(sched.pending) >= self.max_queue_depth * factor:
-                return ("queue_full", 1)
+                return ("queue_full", self.predicted_retry_after())
         if priority == "low" and self.degraded_reasons():
-            return ("degraded", 2)
+            return ("degraded", self.predicted_retry_after(floor=2))
+        if (
+            self.admission_predict
+            and params is not None
+            and params.deadline_hinted
+            and sched is not None
+            and self.predictor is not None
+        ):
+            budget = min(
+                h for h in (params.deadline_ms, params.ttft_budget_ms)
+                if h is not None
+            )
+            pred = self.predictor.predict(
+                self.estimate_prompt_tokens(params), sched.occupancy()
+            )
+            if pred.ttft_ms > budget:
+                self.m_admission_rejected.labels(
+                    reason="infeasible"
+                ).inc()
+                return ("infeasible", self.predicted_retry_after())
         return None
 
     def begin_drain(self) -> dict:
@@ -2476,6 +2875,7 @@ _KNOWN_PATHS = frozenset(
         "/v1/debug/kv",
         "/v1/debug/timeline",
         "/v1/debug/slo",
+        "/v1/debug/admission",
         "/v1/debug/series",
         "/v1/debug/profile",
         "/v1/drain",
@@ -2628,6 +3028,11 @@ def make_handler(state: ApiState):
                 ))
             elif path == "/v1/debug/slo":
                 self._json(state.slo.snapshot())
+            elif path == "/v1/debug/admission":
+                # predictive-admission introspection (ISSUE 20): the
+                # predictor's calibration, live occupancy, and recent
+                # prediction-error percentiles
+                self._json(state.admission_snapshot())
             elif path == "/v1/debug/series":
                 # in-process time-series: no ?name= lists the tracked
                 # series (plus the anomaly monitor's status); with
@@ -2713,7 +3118,7 @@ def make_handler(state: ApiState):
 
             # load shedding BEFORE the request touches the queue or the
             # engine lock: a refused request costs the server nothing
-            shed = state.admission_decision(params.priority)
+            shed = state.admission_decision(params.priority, params)
             if shed is not None:
                 reason, retry_after = shed
                 state.m_shed.labels(reason=reason).inc()
@@ -2944,7 +3349,13 @@ def make_handler(state: ApiState):
                     self._json(
                         {"error": err},
                         503 if err.get("retryable") else 500,
-                        retry_after=1 if err.get("retryable") else None,
+                        # derived Retry-After (ISSUE 20): quote the
+                        # predicted queue-drain, not a constant
+                        retry_after=(
+                            state.predicted_retry_after()
+                            if err.get("retryable")
+                            else None
+                        ),
                     )
                     return
                 if kind == "done":
@@ -3045,6 +3456,27 @@ def make_handler(state: ApiState):
                 if priority not in ("low", "normal", "high"):
                     raise ValueError(f"unknown priority {priority!r}")
                 params.priority = priority
+            # predictive admission (ISSUE 20): optional latency budgets.
+            # Body fields win; the x-dllama-deadline-ms relay header
+            # (fleet router) backstops deadline_ms so budgets survive
+            # relays and failover re-issues
+            if body.get("deadline_ms") is not None:
+                params.deadline_ms = float(body["deadline_ms"])
+                if params.deadline_ms <= 0:
+                    raise ValueError("deadline_ms must be > 0")
+            if body.get("ttft_budget_ms") is not None:
+                params.ttft_budget_ms = float(body["ttft_budget_ms"])
+                if params.ttft_budget_ms <= 0:
+                    raise ValueError("ttft_budget_ms must be > 0")
+            hdr_deadline = self.headers.get("x-dllama-deadline-ms")
+            if hdr_deadline and params.deadline_ms is None:
+                try:
+                    params.deadline_ms = float(hdr_deadline)
+                except ValueError:
+                    pass  # a malformed relay header never fails the request
+                else:
+                    if params.deadline_ms <= 0:
+                        params.deadline_ms = None
             # fleet trace propagation (ISSUE 19): adopt the router-minted
             # identity headers; absent outside a fleet
             trace_id = self.headers.get("x-dllama-trace")
@@ -3085,6 +3517,10 @@ def serve(
     max_queue_depth: int | None = None,
     faults: str | None = None,
     replica_id: str | None = None,
+    admission_predict: bool | None = None,
+    admission_max_wait_ms: int | None = None,
+    deadline_default_ms: int | None = None,
+    deadline_priority_step_ms: int | None = None,
 ):
     block, chunk = resolve_lane_knobs(lane_block_size, admission_chunk)
     page_size, pool_pages, native = resolve_kv_knobs(
@@ -3104,6 +3540,12 @@ def serve(
         engine.init_draft_model(draft_path)
     r_max, r_backoff, q_depth = resolve_resilience_knobs(
         retry_max, retry_backoff_ms, max_queue_depth
+    )
+    predict_on, max_wait_ms = resolve_admission_knobs(
+        admission_predict, admission_max_wait_ms
+    )
+    ddl_default, ddl_step = resolve_deadline_knobs(
+        deadline_default_ms, deadline_priority_step_ms
     )
     if faults is not None:
         # arm the process-wide chaos plane for this server's lifetime
@@ -3130,6 +3572,10 @@ def serve(
         retry_backoff_ms=r_backoff,
         max_queue_depth=q_depth,
         replica_id=replica_id,
+        admission_predict=predict_on,
+        admission_max_wait_ms=max_wait_ms,
+        deadline_default_ms=ddl_default,
+        deadline_priority_step_ms=ddl_step,
     )
     if postmortem_dir:
         # a crashed scheduler loop / engine step dumps the event ring here
@@ -3247,6 +3693,10 @@ def main(argv=None) -> None:
                 max_queue_depth=args.max_queue_depth,
                 faults=args.faults,
                 replica_id=args.replica_id,
+                admission_predict=args.admission_predict,
+                admission_max_wait_ms=args.admission_max_wait_ms,
+                deadline_default_ms=args.deadline_default_ms,
+                deadline_priority_step_ms=args.deadline_priority_step_ms,
             )
             _install_drain_handler(server)
             server.serve_forever()

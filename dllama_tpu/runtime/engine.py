@@ -3270,6 +3270,26 @@ class InferenceEngine:
                 g_roof.labels(kind=kind).set(frac)
         return {"hbm_peak_bytes_per_s": peak, "kinds": per_kind}
 
+    def occupancy(self) -> dict:
+        """The engine's static contribution to an admission-control
+        occupancy snapshot (runtime/admission.py): lane capacity and the
+        measured per-kind step-time p50s the LoadPredictor forecasts
+        from. The scheduler overlays the dynamic half (active lanes,
+        parked streams, queue depth) under its own lock."""
+        step_p50_s: dict[str, float] = {}
+        for kind in ("decode_lanes", "prefill_lane_chunk", "verify_lanes"):
+            try:
+                p50 = self._m_step.labels(kind=kind).percentile(0.5)
+            except Exception:
+                p50 = None
+            if p50 is not None:
+                step_p50_s[kind] = p50
+        return {
+            "lanes_total": self.batch_size,
+            "prefill_buckets": list(self.prefill_buckets),
+            "step_p50_s": step_p50_s,
+        }
+
     def _xlalint_baseline_set(self) -> set:
         if self._xlalint_baseline is None:
             from ..analysis.core import load_baseline

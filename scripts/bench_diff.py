@@ -62,6 +62,10 @@ WATCHED: dict[str, str] = {
     # victim's last relayed byte and the sibling's catch-up chunk on the
     # seeded kill round (ISSUE 19)
     "SERVING.fleet.fleet_obs.failover_gap_ms_p99": "lower",
+    # SLO-met tokens/s under the 4x mixed-deadline overload wave with
+    # predictive admission on: a drop means the predictor stopped
+    # steering lane time away from infeasible work (ISSUE 20)
+    "SERVING.overload.goodput_tok_s": "higher",
 }
 
 

@@ -13,6 +13,7 @@ import http.client
 import json
 import re
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -168,7 +169,7 @@ def _url(srv):
     return f"http://127.0.0.1:{srv.server_address[1]}"
 
 
-def _ask(srv, prompt, max_tokens=8, priority=None, timeout=300):
+def _ask(srv, prompt, max_tokens=8, priority=None, timeout=300, extra=None):
     """One non-stream completion. Returns ("ok", content) or
     ("error", status, error_dict, retry_after_header)."""
     payload = {
@@ -178,6 +179,8 @@ def _ask(srv, prompt, max_tokens=8, priority=None, timeout=300):
     }
     if priority is not None:
         payload["priority"] = priority
+    if extra:
+        payload.update(extra)
     req = urllib.request.Request(
         _url(srv) + "/v1/chat/completions",
         data=json.dumps(payload).encode(),
@@ -483,6 +486,76 @@ def test_degraded_sheds_low_priority_only(chaos_server):
 def test_bad_priority_rejected(chaos_server):
     res = _ask(chaos_server, "hi", priority="vip")
     assert res[0] == "error" and res[1] == 400
+
+
+def test_chaos_overload_predictive_admission(chaos_server):
+    """Fault plane + overload + predictive admission (ISSUE 20): 3x the
+    lane count of mixed-priority, mixed-deadline requests under a
+    transient fault sprinkle. The scheduler never dies, every response
+    is either a completed stream or a structured retryable error, and
+    hopeless budgets are shed as infeasible up front instead of queuing
+    to fail slowly."""
+    state = chaos_server.state
+    sched = state.scheduler
+    prompts = [f"overload wave request {i}" for i in range(12)]
+    extras = []
+    for i in range(12):
+        e = {"priority": ("high", "normal", "low")[i % 3]}
+        if i % 4 == 0:
+            e["deadline_ms"] = 300_000.0  # generous: feasible
+        elif i % 4 == 2:
+            e["ttft_budget_ms"] = 0.0001  # hopeless: must shed
+        extras.append(e)
+    hopeless = [i for i in range(12) if i % 4 == 2]
+    b_rejected = dict(state.m_admission_rejected.child_values())
+
+    state.admission_predict = True
+    plane = set_fault_plane("dispatch:p=0.05:seed=13")
+    results = [None] * 12
+    try:
+
+        def worker(i):
+            results[i] = _ask(chaos_server, prompts[i], extra=extras[i])
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(12)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+    finally:
+        set_fault_plane("")
+        state.admission_predict = False
+    assert all(r is not None for r in results), "an overload worker hung"
+
+    for i, res in enumerate(results):
+        if res[0] == "ok":
+            continue
+        _, code, err, retry_after = res
+        assert code in (429, 503), (i, res)
+        assert err.get("retryable") is True, (i, err)
+        assert retry_after is not None and int(retry_after) >= 1, (i, res)
+    # every hopeless budget was refused (never served); the rest
+    # completed — the transient sprinkle is absorbed by retry/backoff
+    for i in hopeless:
+        assert results[i][0] == "error", (i, results[i])
+    for i in range(12):
+        if i not in hopeless:
+            assert results[i][0] == "ok", (i, results[i])
+    rejected = state.m_admission_rejected.child_values()
+    assert rejected.get(("infeasible",), 0) >= (
+        b_rejected.get(("infeasible",), 0) + 2
+    )
+
+    # the invariants the chaos plane holds everywhere
+    assert sched.thread.is_alive(), "scheduler died under overload"
+    t_end = time.time() + 180
+    while time.time() < t_end and (sched.admitting or sched.pending):
+        time.sleep(0.02)
+    assert not sched.admitting and not sched.pending
+    sched.kv.check()
+    assert _ask(chaos_server, "after the overload wave")[0] == "ok"
 
 
 # -- graceful drain -----------------------------------------------------------

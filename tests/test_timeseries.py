@@ -415,6 +415,7 @@ def test_default_rules_cover_the_production_signals():
     rules = build_default_rules(store)
     assert [r.signal for r in rules] == [
         "decode_stall", "ttft", "tpot", "kv_free_slope", "goodput",
+        "predict_error",
     ]
     # every rule's value_fn is callable against an empty store (returns
     # None, which neither fires nor learns)
